@@ -1,0 +1,19 @@
+"""Root pytest config: force the CPU backend with 8 virtual devices and f64.
+
+Must run before jax initialises its backends, hence env vars here rather than
+in a fixture. This is the TPU analogue of the reference's "just run mpirun"
+testing strategy (examples/README.md section Testing): the same engine runs
+on an emulated 8-device mesh so every sharded code path executes in CI.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("QUEST_PRECISION", "2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

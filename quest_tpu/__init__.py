@@ -1,0 +1,52 @@
+"""quest_tpu: a TPU-native full-state quantum circuit simulator.
+
+A ground-up JAX/XLA re-design with the full capability surface of QuEST
+(the Quantum Exact Simulation Toolkit): state-vectors and density matrices,
+~140 API functions (unitaries, decoherence channels, calculations, operators,
+QASM logging), distribution via ``jax.sharding`` over TPU meshes instead of
+MPI, and kernels expressed as XLA-fusable tensor programs instead of
+hand-written loops.
+
+Public names match the reference C API (``hadamard``, ``controlledNot``,
+``calcFidelity``, ...) so a QuEST program ports by swapping includes for
+imports; see README for the idiomatic-JAX functional layer underneath.
+
+Architecture map (reference -> here):
+  QuEST.h / QuEST.c (L5 API)      -> this package's top-level modules
+  QuEST_validation.c (L4a)        -> validation.py
+  QuEST_qasm.c (L4b)              -> qasm.py
+  mt19937ar.c (L4c RNG)           -> numpy MT19937 in environment.py
+  QuEST_common.c (L3 algorithms)  -> matrices.py + per-module logic
+  QuEST_internal.h (L2 contract)  -> ops/ (pure jitted kernels)
+  QuEST_cpu*.c / QuEST_gpu*.cu    -> ops/* via XLA (one backend, all targets)
+  MPI exchange (L1 distributed)   -> parallel/ + XLA SPMD collectives
+"""
+
+from .datatypes import (  # noqa: F401
+    PAULI_I, PAULI_X, PAULI_Y, PAULI_Z,
+    DiagonalOp, PauliHamil, SubDiagonalOp, Vector, bitEncoding,
+    createComplexMatrixN, createPauliHamil, createPauliHamilFromFile,
+    createSubDiagonalOp, destroyComplexMatrixN, destroyPauliHamil,
+    destroySubDiagonalOp, getStaticComplexMatrixN, initComplexMatrixN,
+    initPauliHamil, pauliOpType, phaseFunc,
+)
+from .environment import (  # noqa: F401
+    QuESTEnv, createQuESTEnv, destroyQuESTEnv, getEnvironmentString,
+    getQuESTSeeds, reportQuESTEnv, seedQuEST, seedQuESTDefault, syncQuESTEnv,
+    syncQuESTSuccess,
+)
+from .registers import (  # noqa: F401
+    Qureg, createCloneQureg, createDensityQureg, createQureg, destroyQureg,
+    get_np,
+)
+from .validation import (  # noqa: F401
+    QuESTError, invalid_quest_input_error, set_input_error_handler,
+)
+from .state_init import *  # noqa: F401,F403
+from .gates import *  # noqa: F401,F403
+from .calculations import *  # noqa: F401,F403
+from .decoherence import *  # noqa: F401,F403
+from .operators import *  # noqa: F401,F403
+from .reporting import *  # noqa: F401,F403
+
+__version__ = "0.1.0"

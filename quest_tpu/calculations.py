@@ -1,0 +1,211 @@
+"""Calculations: probabilities, inner products, expectation values
+(reference QuEST.h:2404-2516, 3544-3799, 4247-4917; kernels in ops.reduce).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import validation as V
+from .datatypes import PauliHamil, pauliOpType
+from .ops import measure as M, reduce as R
+from .registers import Qureg, createCloneQureg, get_np
+
+__all__ = [
+    "calcTotalProb", "calcProbOfOutcome", "calcProbOfAllOutcomes",
+    "calcInnerProduct", "calcDensityInnerProduct", "calcPurity", "calcFidelity",
+    "calcHilbertSchmidtDistance", "calcExpecPauliProd", "calcExpecPauliSum",
+    "calcExpecPauliHamil", "getAmp", "getRealAmp", "getImagAmp", "getProbAmp",
+    "getDensityAmp",
+]
+
+
+def calcTotalProb(qureg: Qureg) -> float:
+    """sum |amp|^2 (state-vector) or Re tr(rho) (density) (QuEST.h:2516)."""
+    if qureg.is_density_matrix:
+        return float(R.total_prob_density(qureg.amps, n=qureg.num_qubits_represented))
+    return float(R.total_prob_statevec(qureg.amps))
+
+
+def calcProbOfOutcome(qureg: Qureg, target: int, outcome: int) -> float:
+    func = "calcProbOfOutcome"
+    V.validate_target(qureg, target, func)
+    V.validate_outcome(outcome, func)
+    if qureg.is_density_matrix:
+        return float(M.density_prob_of_outcome(
+            qureg.amps, n=qureg.num_qubits_represented, target=target, outcome=outcome))
+    return float(M.prob_of_outcome(
+        qureg.amps, n=qureg.num_qubits_in_state_vec, target=target, outcome=outcome))
+
+
+def calcProbOfAllOutcomes(qureg: Qureg, targets) -> np.ndarray:
+    """2^t outcome distribution; targets[0] is the outcome's least-significant
+    bit (QuEST.h:3633)."""
+    func = "calcProbOfAllOutcomes"
+    V.validate_multi_targets(qureg, targets, func)
+    if qureg.is_density_matrix:
+        p = M.density_prob_of_all_outcomes(
+            qureg.amps, n=qureg.num_qubits_represented, targets=tuple(targets))
+    else:
+        p = M.prob_of_all_outcomes(
+            qureg.amps, n=qureg.num_qubits_in_state_vec, targets=tuple(targets))
+    return np.asarray(p)
+
+
+def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
+    """<bra|ket> (QuEST.h:3746)."""
+    func = "calcInnerProduct"
+    V.validate_state_vec(bra, func)
+    V.validate_state_vec(ket, func)
+    V.validate_matching_qureg_dims(bra, ket, func)
+    re, im = R.inner_product(bra.amps, ket.amps)
+    return complex(float(re), float(im))
+
+
+def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
+    """Re Tr(rho1^dag rho2) (QuEST.h:3799)."""
+    func = "calcDensityInnerProduct"
+    V.validate_density_matr(rho1, func)
+    V.validate_density_matr(rho2, func)
+    V.validate_matching_qureg_dims(rho1, rho2, func)
+    return float(R.density_inner_product(rho1.amps, rho2.amps))
+
+
+def calcPurity(qureg: Qureg) -> float:
+    """Tr(rho^2) (QuEST.h:4247)."""
+    V.validate_density_matr(qureg, "calcPurity")
+    return float(R.purity_density(qureg.amps))
+
+
+def calcFidelity(qureg: Qureg, pure_state: Qureg) -> float:
+    """|<psi|phi>|^2 or <psi|rho|psi> (QuEST.h:4283)."""
+    func = "calcFidelity"
+    V.validate_second_qureg_state_vec(pure_state, func)
+    V.validate_matching_qureg_dims(qureg, pure_state, func)
+    if qureg.is_density_matrix:
+        return float(R.density_fidelity(qureg.amps, pure_state.amps,
+                                        n=qureg.num_qubits_represented))
+    re, im = R.inner_product(qureg.amps, pure_state.amps)
+    return float(re) ** 2 + float(im) ** 2
+
+
+def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
+    """sqrt(sum |a-b|^2) (QuEST.h:5663)."""
+    func = "calcHilbertSchmidtDistance"
+    V.validate_density_matr(a, func)
+    V.validate_density_matr(b, func)
+    V.validate_matching_qureg_dims(a, b, func)
+    return float(R.hilbert_schmidt_distance(a.amps, b.amps))
+
+
+# ---------------------------------------------------------------------------
+# Pauli expectation values (logic: QuEST_common.c:491-555)
+# ---------------------------------------------------------------------------
+
+def _apply_pauli_prod(workspace: Qureg, targets, codes) -> None:
+    """Apply a product of Paulis gate-wise to the workspace (the clone-based
+    scheme of statevec_calcExpecPauliProd, QuEST_common.c:505-518). Note the
+    workspace is treated as a plain 2N-amplitude vector even for density
+    matrices (no shadow op), matching the reference."""
+    from . import matrices
+    from .ops import apply as K, cplx, diagonal as D
+    nsv = workspace.num_qubits_in_state_vec
+    dt = workspace.dtype
+    amps = workspace.amps
+    for t, c in zip(targets, codes):
+        c = int(c)
+        if c == 0:
+            continue
+        if c == 1:
+            amps = K.apply_x_class(amps, n=nsv, targets=(int(t),))
+        elif c == 2:
+            amps = K.apply_matrix(amps, cplx.from_complex(matrices.PAULI_Y_M, dt),
+                                  n=nsv, targets=(int(t),))
+        else:
+            amps = D.apply_diagonal(amps, cplx.from_complex(np.array([1.0, -1.0]), dt),
+                                    n=nsv, targets=(int(t),))
+    workspace.put(amps)
+
+
+def calcExpecPauliProd(qureg: Qureg, targets, paulis, workspace: Qureg) -> float:
+    """<qureg| P |qureg> (QuEST.h:4777). The workspace is clobbered with
+    P|qureg>, matching the reference's contract."""
+    func = "calcExpecPauliProd"
+    V.validate_multi_targets(qureg, targets, func)
+    V.validate_num_pauli_codes(paulis, len(targets), func)
+    V.validate_matching_qureg_types(qureg, workspace, func)
+    V.validate_matching_qureg_dims(qureg, workspace, func)
+    workspace.put(qureg.amps + 0)
+    _apply_pauli_prod(workspace, targets, paulis)
+    if qureg.is_density_matrix:
+        # Tr(P rho): the reference takes densmatr_calcTotalProb of P.rho
+        return float(R.total_prob_density(workspace.amps, n=qureg.num_qubits_represented))
+    return float(R.inner_product(qureg.amps, workspace.amps)[0])
+
+
+def calcExpecPauliSum(qureg: Qureg, all_pauli_codes, term_coeffs, workspace: Qureg) -> float:
+    """sum_t c_t <P_t> (QuEST.h:4832); clone-per-term like the reference
+    (QuEST_common.c:520-532)."""
+    func = "calcExpecPauliSum"
+    codes = np.asarray(all_pauli_codes, dtype=np.int32).reshape(len(term_coeffs), -1)
+    V._assert(codes.size == len(term_coeffs) * qureg.num_qubits_represented,
+              "Invalid number of Pauli codes. The number of codes must equal numQubits * numSumTerms.",
+              func)
+    V.validate_pauli_codes(codes.ravel(), func)
+    V.validate_matching_qureg_types(qureg, workspace, func)
+    V.validate_matching_qureg_dims(qureg, workspace, func)
+    n = qureg.num_qubits_represented
+    total = 0.0
+    targets = list(range(n))
+    for t in range(codes.shape[0]):
+        workspace.put(qureg.amps + 0)
+        _apply_pauli_prod(workspace, targets, codes[t])
+        if qureg.is_density_matrix:
+            term = float(R.total_prob_density(workspace.amps, n=n))
+        else:
+            term = float(R.inner_product(qureg.amps, workspace.amps)[0])
+        total += float(term_coeffs[t]) * term
+    return total
+
+
+def calcExpecPauliHamil(qureg: Qureg, hamil: PauliHamil, workspace: Qureg) -> float:
+    """(QuEST.h:4873)."""
+    func = "calcExpecPauliHamil"
+    V.validate_pauli_hamil(hamil, func)
+    V.validate_hamil_matches_qureg(qureg, hamil, func)
+    return calcExpecPauliSum(qureg, hamil.pauli_codes, hamil.term_coeffs, workspace)
+
+
+# ---------------------------------------------------------------------------
+# amplitude getters (QuEST.h:2404-2489)
+# ---------------------------------------------------------------------------
+
+def getAmp(qureg: Qureg, index: int) -> complex:
+    func = "getAmp"
+    V.validate_state_vec(qureg, func)
+    V.validate_amp_index(qureg, index, func)
+    return complex(float(qureg.amps[0, index]), float(qureg.amps[1, index]))
+
+
+def getRealAmp(qureg: Qureg, index: int) -> float:
+    return getAmp(qureg, index).real
+
+
+def getImagAmp(qureg: Qureg, index: int) -> float:
+    return getAmp(qureg, index).imag
+
+
+def getProbAmp(qureg: Qureg, index: int) -> float:
+    a = getAmp(qureg, index)
+    return a.real * a.real + a.imag * a.imag
+
+
+def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
+    """rho[row, col] (QuEST.h:2489); flat index col*2^N + row."""
+    func = "getDensityAmp"
+    V.validate_density_matr(qureg, func)
+    dim = 1 << qureg.num_qubits_represented
+    V._assert(0 <= row < dim and 0 <= col < dim,
+              "Invalid amplitude index. Note amplitudes are zero indexed.", func)
+    i = col * dim + row
+    return complex(float(qureg.amps[0, i]), float(qureg.amps[1, i]))

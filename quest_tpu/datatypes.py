@@ -1,0 +1,250 @@
+"""User-facing data structures (reference: ``QuEST/include/QuEST.h``).
+
+The reference's planar (SoA) ``ComplexArray`` layout (QuEST.h:94-98) is an
+implementation detail of its C kernels; here gate matrices are plain
+numpy/jax arrays and the state itself is a complex jax.Array (XLA stores
+complex as a (re, im) pair internally, which is the same planar layout).
+
+Structures:
+  - pauliOpType enum            (QuEST.h:262-270)
+  - phaseFunc / bitEncoding     (QuEST.h enums for the phase-function family)
+  - ComplexMatrix2/4/N helpers  (QuEST.h:154-208; create/destroy are no-ops
+                                 in Python -- any (2^n, 2^n) array-like works)
+  - Vector                      (QuEST.h:215-218)
+  - PauliHamil                  (QuEST.h:296-307, createPauliHamilFromFile QuEST.h:914)
+  - DiagonalOp                  (QuEST.h:316-332) -- full 2^N diagonal, device-resident
+  - SubDiagonalOp               (QuEST.h:340-351) -- small diagonal on <=N targets
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import precision, validation
+
+
+class pauliOpType(enum.IntEnum):
+    """Pauli operator codes, as the reference enum (QuEST.h:262-270)."""
+
+    PAULI_I = 0
+    PAULI_X = 1
+    PAULI_Y = 2
+    PAULI_Z = 3
+
+
+PAULI_I = pauliOpType.PAULI_I
+PAULI_X = pauliOpType.PAULI_X
+PAULI_Y = pauliOpType.PAULI_Y
+PAULI_Z = pauliOpType.PAULI_Z
+
+#: dense 2x2 matrices for each Pauli code (row-major, numpy)
+PAULI_MATRICES = {
+    0: np.eye(2, dtype=np.complex128),
+    1: np.array([[0, 1], [1, 0]], dtype=np.complex128),
+    2: np.array([[0, -1j], [1j, 0]], dtype=np.complex128),
+    3: np.array([[1, 0], [0, -1]], dtype=np.complex128),
+}
+
+
+class bitEncoding(enum.IntEnum):
+    """Sub-register value encodings for phase functions (QuEST.h enum bitEncoding)."""
+
+    UNSIGNED = 0
+    TWOS_COMPLEMENT = 1
+
+
+class phaseFunc(enum.IntEnum):
+    """Named phase functions (QuEST.h enum phaseFunc)."""
+
+    NORM = 0
+    SCALED_NORM = 1
+    INVERSE_NORM = 2
+    SCALED_INVERSE_NORM = 3
+    SCALED_INVERSE_SHIFTED_NORM = 4
+    PRODUCT = 5
+    SCALED_PRODUCT = 6
+    INVERSE_PRODUCT = 7
+    SCALED_INVERSE_PRODUCT = 8
+    DISTANCE = 9
+    SCALED_DISTANCE = 10
+    INVERSE_DISTANCE = 11
+    SCALED_INVERSE_DISTANCE = 12
+    SCALED_INVERSE_SHIFTED_DISTANCE = 13
+    SCALED_INVERSE_SHIFTED_WEIGHTED_DISTANCE = 14
+
+
+@dataclass
+class Vector:
+    """A 3-vector, used for Bloch-axis rotations (QuEST.h:215-218)."""
+
+    x: float
+    y: float
+    z: float
+
+    def __getitem__(self, i):
+        return (self.x, self.y, self.z)[i]
+
+
+# ---------------------------------------------------------------------------
+# gate matrices
+# ---------------------------------------------------------------------------
+
+def createComplexMatrixN(num_qubits: int) -> np.ndarray:
+    """Zeroed 2^n x 2^n gate matrix (reference: createComplexMatrixN, QuEST.c:775-819).
+
+    In Python any array-like of that shape is accepted by the apply functions;
+    this exists for API parity and convenience.
+    """
+    validation.validate_num_qubits(num_qubits, "createComplexMatrixN")
+    dim = 2 ** num_qubits
+    return np.zeros((dim, dim), dtype=np.complex128)
+
+
+def destroyComplexMatrixN(matrix) -> None:
+    """No-op (garbage collected); kept for API parity."""
+
+
+def initComplexMatrixN(matrix: np.ndarray, real, imag) -> None:
+    """Overwrite a matrix from real/imag nested lists (initComplexMatrixN, QuEST.c)."""
+    matrix[...] = np.asarray(real) + 1j * np.asarray(imag)
+
+
+def getStaticComplexMatrixN(real, imag) -> np.ndarray:
+    """Build a matrix from nested lists (reference macro getStaticComplexMatrixN)."""
+    return np.asarray(real) + 1j * np.asarray(imag)
+
+
+# ---------------------------------------------------------------------------
+# PauliHamil
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PauliHamil:
+    """Real-weighted sum of Pauli products (QuEST.h:296-307).
+
+    ``pauli_codes`` has shape (num_sum_terms, num_qubits): codes[t, q] is the
+    Pauli acting on qubit q in term t (the reference flattens this to a single
+    array of length numSumTerms*numQubits with the same ordering).
+    """
+
+    num_qubits: int
+    num_sum_terms: int
+    pauli_codes: np.ndarray = field(default=None)
+    term_coeffs: np.ndarray = field(default=None)
+
+    def __post_init__(self):
+        if self.pauli_codes is None:
+            self.pauli_codes = np.zeros((self.num_sum_terms, self.num_qubits), dtype=np.int32)
+        else:
+            self.pauli_codes = np.asarray(self.pauli_codes, dtype=np.int32).reshape(
+                self.num_sum_terms, self.num_qubits)
+        if self.term_coeffs is None:
+            self.term_coeffs = np.zeros((self.num_sum_terms,), dtype=np.float64)
+        else:
+            self.term_coeffs = np.asarray(self.term_coeffs, dtype=np.float64).reshape(
+                self.num_sum_terms)
+
+
+def createPauliHamil(num_qubits: int, num_sum_terms: int) -> PauliHamil:
+    """Blank Hamiltonian (createPauliHamil, QuEST.h:858)."""
+    func = "createPauliHamil"
+    validation.validate_num_qubits(num_qubits, func)
+    validation._assert(num_sum_terms > 0, "Invalid number of terms in the PauliHamil. The number of terms must be strictly positive.", func)
+    return PauliHamil(num_qubits, num_sum_terms)
+
+
+def destroyPauliHamil(hamil: PauliHamil) -> None:
+    """No-op; kept for API parity."""
+
+
+def initPauliHamil(hamil: PauliHamil, coeffs, codes) -> None:
+    """Overwrite a Hamiltonian in-place (initPauliHamil, QuEST.h:953)."""
+    func = "initPauliHamil"
+    codes = np.asarray(codes, dtype=np.int32).reshape(hamil.num_sum_terms, hamil.num_qubits)
+    validation.validate_pauli_codes(codes.ravel(), func)
+    hamil.term_coeffs[...] = np.asarray(coeffs, dtype=np.float64)
+    hamil.pauli_codes[...] = codes
+
+
+def createPauliHamilFromFile(path: str) -> PauliHamil:
+    """Parse the reference's Hamiltonian file format (createPauliHamilFromFile,
+    QuEST.h:914): each line is ``coeff code code ... code`` with one code per
+    qubit; the qubit count is inferred from the first line."""
+    func = "createPauliHamilFromFile"
+    coeffs, codes = [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            coeffs.append(float(parts[0]))
+            codes.append([int(float(c)) for c in parts[1:]])
+    validation._assert(len(coeffs) > 0, "Could not parse the PauliHamil file.", func)
+    num_qubits = len(codes[0])
+    validation._assert(num_qubits > 0, "Could not parse the PauliHamil file.", func)
+    validation._assert(all(len(c) == num_qubits for c in codes),
+                       "Could not parse the PauliHamil file.", func)
+    hamil = PauliHamil(num_qubits, len(coeffs), np.asarray(codes), np.asarray(coeffs))
+    validation.validate_pauli_hamil(hamil, func)
+    return hamil
+
+
+def pauli_term_matrix(codes_row) -> np.ndarray:
+    """Dense 2^N matrix of one Pauli product term; qubit 0 = least-significant
+    index bit, so it is the *last* factor of the Kronecker product."""
+    m = np.eye(1, dtype=np.complex128)
+    for code in reversed(list(codes_row)):
+        m = np.kron(m, PAULI_MATRICES[int(code)])
+    return m
+
+
+# ---------------------------------------------------------------------------
+# DiagonalOp / SubDiagonalOp
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DiagonalOp:
+    """Full-Hilbert 2^N diagonal operator (QuEST.h:316-332).
+
+    The reference keeps a host copy plus a persistent GPU copy synced by
+    ``syncDiagonalOp`` (QuEST_gpu_common.cu:508-640). Here ``elems`` is a
+    device jax.Array (shardable exactly like a Qureg); set/sync update it
+    functionally.
+    """
+
+    num_qubits: int
+    elems: jnp.ndarray  # planar (2, 2^N): [0]=real plane, [1]=imag plane
+
+    @property
+    def real(self) -> np.ndarray:
+        return np.asarray(self.elems[0])
+
+    @property
+    def imag(self) -> np.ndarray:
+        return np.asarray(self.elems[1])
+
+
+@dataclass
+class SubDiagonalOp:
+    """Diagonal operator on a subset of <=N qubits (QuEST.h:340-351); small and
+    replicated (never sharded)."""
+
+    num_qubits: int
+    elems: np.ndarray
+
+    @property
+    def num_elems(self) -> int:
+        return 2 ** self.num_qubits
+
+
+def createSubDiagonalOp(num_qubits: int) -> SubDiagonalOp:
+    validation.validate_num_qubits(num_qubits, "createSubDiagonalOp")
+    return SubDiagonalOp(num_qubits, np.zeros(2 ** num_qubits, dtype=np.complex128))
+
+
+def destroySubDiagonalOp(op: SubDiagonalOp) -> None:
+    """No-op; kept for API parity."""

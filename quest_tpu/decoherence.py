@@ -1,0 +1,155 @@
+"""Decoherence channels on density matrices (reference QuEST.h:3976-4219,
+5412-5630; kernels in ops.density).
+
+Every channel is either a broadcasted diagonal factor (dephasing) or one dense
+superoperator application on qubits (T, T+N) -- see ops/density.py for why
+this single mechanism replaces the reference's bespoke MPI protocols.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import validation as V
+from .ops import density as DN, init as I
+from .registers import Qureg
+
+__all__ = [
+    "mixDephasing", "mixTwoQubitDephasing", "mixDepolarising", "mixDamping",
+    "mixTwoQubitDepolarising", "mixPauli", "mixDensityMatrix", "mixKrausMap",
+    "mixTwoQubitKrausMap", "mixMultiQubitKrausMap", "mixNonTPKrausMap",
+    "mixNonTPTwoQubitKrausMap", "mixNonTPMultiQubitKrausMap",
+]
+
+
+def _record(qureg, text):
+    if qureg.qasm_log is not None:
+        qureg.qasm_log.record_comment(text)
+
+
+def mixDephasing(qureg: Qureg, target: int, prob: float) -> None:
+    """rho -> (1-p) rho + p Z rho Z (QuEST.h:3976)."""
+    func = "mixDephasing"
+    V.validate_density_matr(qureg, func)
+    V.validate_target(qureg, target, func)
+    V.validate_one_qubit_dephase_prob(prob, func)
+    qureg.put(DN.apply_dephasing(qureg.amps, prob, n=qureg.num_qubits_represented,
+                                 target=target))
+    _record(qureg, f"mixDephasing({prob:g}) on q[{target}]")
+
+
+def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
+    """(QuEST.h:4008)."""
+    func = "mixTwoQubitDephasing"
+    V.validate_density_matr(qureg, func)
+    V.validate_unique_targets(qureg, q1, q2, func)
+    V.validate_two_qubit_dephase_prob(prob, func)
+    qureg.put(DN.apply_two_qubit_dephasing(qureg.amps, prob,
+                                           n=qureg.num_qubits_represented, q1=q1, q2=q2))
+    _record(qureg, f"mixTwoQubitDephasing({prob:g}) on q[{q1}],q[{q2}]")
+
+
+def mixDepolarising(qureg: Qureg, target: int, prob: float) -> None:
+    """rho -> (1-p) rho + p/3 (X rho X + Y rho Y + Z rho Z) (QuEST.h:4051)."""
+    func = "mixDepolarising"
+    V.validate_density_matr(qureg, func)
+    V.validate_target(qureg, target, func)
+    V.validate_one_qubit_depol_prob(prob, func)
+    superop = DN.kraus_superoperator(DN.depolarising_kraus(prob))
+    qureg.put(DN.apply_channel(qureg.amps, superop, n=qureg.num_qubits_represented,
+                               targets=(target,)))
+    _record(qureg, f"mixDepolarising({prob:g}) on q[{target}]")
+
+
+def mixDamping(qureg: Qureg, target: int, prob: float) -> None:
+    """Amplitude damping toward |0> (QuEST.h:4089)."""
+    func = "mixDamping"
+    V.validate_density_matr(qureg, func)
+    V.validate_target(qureg, target, func)
+    V.validate_one_qubit_damping_prob(prob, func)
+    superop = DN.kraus_superoperator(DN.damping_kraus(prob))
+    qureg.put(DN.apply_channel(qureg.amps, superop, n=qureg.num_qubits_represented,
+                               targets=(target,)))
+    _record(qureg, f"mixDamping({prob:g}) on q[{target}]")
+
+
+def mixTwoQubitDepolarising(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
+    """(QuEST.h:4156; 3-exchange MPI protocol QuEST_cpu_distributed.c:778-868,
+    here a single 16x16 superoperator)."""
+    func = "mixTwoQubitDepolarising"
+    V.validate_density_matr(qureg, func)
+    V.validate_unique_targets(qureg, q1, q2, func)
+    V.validate_two_qubit_depol_prob(prob, func)
+    superop = DN.two_qubit_depolarising_superop(prob)
+    qureg.put(DN.apply_channel(qureg.amps, superop, n=qureg.num_qubits_represented,
+                               targets=(q1, q2)))
+    _record(qureg, f"mixTwoQubitDepolarising({prob:g}) on q[{q1}],q[{q2}]")
+
+
+def mixPauli(qureg: Qureg, target: int, px: float, py: float, pz: float) -> None:
+    """General Pauli channel (QuEST.h:4197; 4-op Kraus, QuEST_common.c:740-760)."""
+    func = "mixPauli"
+    V.validate_density_matr(qureg, func)
+    V.validate_target(qureg, target, func)
+    V.validate_pauli_probs(px, py, pz, func)
+    superop = DN.kraus_superoperator(DN.pauli_kraus(px, py, pz))
+    qureg.put(DN.apply_channel(qureg.amps, superop, n=qureg.num_qubits_represented,
+                               targets=(target,)))
+    _record(qureg, f"mixPauli({px:g},{py:g},{pz:g}) on q[{target}]")
+
+
+def mixDensityMatrix(combine: Qureg, prob: float, other: Qureg) -> None:
+    """combine = (1-p) combine + p other (QuEST.h:4219)."""
+    func = "mixDensityMatrix"
+    V.validate_density_matr(combine, func)
+    V.validate_density_matr(other, func)
+    V.validate_matching_qureg_dims(combine, other, func)
+    V.validate_probability(prob, 1.0, func)
+    dt = combine.dtype
+    import jax.numpy as jnp
+
+    def planar(v):
+        return jnp.asarray([v, 0.0], dtype=dt)
+
+    combine.put(I.weighted_sum(planar(1 - prob), combine.amps,
+                               planar(prob), other.amps,
+                               planar(0.0), combine.amps))
+    _record(combine, f"mixDensityMatrix({prob:g})")
+
+
+def _mix_kraus(qureg, targets, ops, func, check_cptp):
+    V.validate_density_matr(qureg, func)
+    V.validate_multi_targets(qureg, targets, func)
+    V.validate_kraus_ops(ops, len(targets), qureg.eps, func, check_cptp=check_cptp)
+    superop = DN.kraus_superoperator(ops)
+    qureg.put(DN.apply_channel(qureg.amps, superop, n=qureg.num_qubits_represented,
+                               targets=tuple(targets)))
+    _record(qureg, f"{func} on qubits {list(targets)}")
+
+
+def mixKrausMap(qureg: Qureg, target: int, ops) -> None:
+    """1-qubit Kraus map of up to 4 operators (QuEST.h:5412)."""
+    _mix_kraus(qureg, (target,), ops, "mixKrausMap", True)
+
+
+def mixTwoQubitKrausMap(qureg: Qureg, q1: int, q2: int, ops) -> None:
+    """(QuEST.h:5453); matrix bit order: q1 is the least-significant bit."""
+    _mix_kraus(qureg, (q1, q2), ops, "mixTwoQubitKrausMap", True)
+
+
+def mixMultiQubitKrausMap(qureg: Qureg, targets, ops) -> None:
+    """(QuEST.h:5505)."""
+    _mix_kraus(qureg, tuple(targets), ops, "mixMultiQubitKrausMap", True)
+
+
+def mixNonTPKrausMap(qureg: Qureg, target: int, ops) -> None:
+    """Non-trace-preserving variant (QuEST.h:5540)."""
+    _mix_kraus(qureg, (target,), ops, "mixNonTPKrausMap", False)
+
+
+def mixNonTPTwoQubitKrausMap(qureg: Qureg, q1: int, q2: int, ops) -> None:
+    _mix_kraus(qureg, (q1, q2), ops, "mixNonTPTwoQubitKrausMap", False)
+
+
+def mixNonTPMultiQubitKrausMap(qureg: Qureg, targets, ops) -> None:
+    _mix_kraus(qureg, tuple(targets), ops, "mixNonTPMultiQubitKrausMap", False)

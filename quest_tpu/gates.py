@@ -1,0 +1,528 @@
+"""Unitaries, measurement and collapse: the reference's L5 gate API
+(``QuEST/src/QuEST.c``; declarations QuEST.h:1916-4760).
+
+Every function follows the reference's invariant structure (QuEST.c:5-6):
+validate -> state-vector op -> (density) conjugated shadow op on the shifted
+qubits (QuEST.c:184-193) -> QASM record. API functions never call each other.
+
+Function names match the reference exactly (hadamard, controlledNot,
+multiControlledMultiQubitUnitary, ...) so a QuEST user can port by changing
+imports only.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import matrices, validation as V
+from .datatypes import SubDiagonalOp, Vector
+from .ops import apply as K, cplx, diagonal as D, measure as M
+from .registers import Qureg
+
+__all__ = [
+    "phaseShift", "controlledPhaseShift", "multiControlledPhaseShift",
+    "controlledPhaseFlip", "multiControlledPhaseFlip", "sGate", "tGate",
+    "compactUnitary", "unitary", "rotateX", "rotateY", "rotateZ",
+    "rotateAroundAxis", "controlledRotateX", "controlledRotateY",
+    "controlledRotateZ", "controlledRotateAroundAxis",
+    "controlledCompactUnitary", "controlledUnitary", "multiControlledUnitary",
+    "multiStateControlledUnitary", "pauliX", "pauliY", "pauliZ", "hadamard",
+    "controlledNot", "multiQubitNot", "multiControlledMultiQubitNot",
+    "controlledPauliY", "swapGate", "sqrtSwapGate", "multiRotateZ",
+    "multiRotatePauli", "multiControlledMultiRotateZ",
+    "multiControlledMultiRotatePauli", "twoQubitUnitary",
+    "controlledTwoQubitUnitary", "multiControlledTwoQubitUnitary",
+    "multiQubitUnitary", "controlledMultiQubitUnitary",
+    "multiControlledMultiQubitUnitary", "diagonalUnitary",
+    "measure", "measureWithStats", "collapseToOutcome",
+]
+
+
+# ---------------------------------------------------------------------------
+# helpers: statevec + density-shadow application
+# ---------------------------------------------------------------------------
+
+def _shift(qs, n):
+    return tuple(q + n for q in qs)
+
+
+def _apply_gate_matrix(qureg: Qureg, matrix, targets, controls=(), states=()):
+    """Gate semantics: U on a state-vector; U . U^dagger on a density matrix
+    via the conj-shadow (QuEST.c:184-193)."""
+    n = qureg.num_qubits_represented
+    nsv = qureg.num_qubits_in_state_vec
+    targets, controls, states = tuple(targets), tuple(controls), tuple(states)
+    m = cplx.from_complex(matrix, qureg.dtype)
+    amps = K.apply_matrix(qureg.amps, m, n=nsv, targets=targets,
+                          controls=controls, control_states=states)
+    if qureg.is_density_matrix:
+        amps = K.apply_matrix(amps, m, n=nsv, targets=_shift(targets, n),
+                              controls=_shift(controls, n), control_states=states,
+                              conj=True)
+    qureg.put(amps)
+
+
+def _apply_gate_diag(qureg: Qureg, diag, targets, controls=()):
+    n = qureg.num_qubits_represented
+    nsv = qureg.num_qubits_in_state_vec
+    targets, controls = tuple(targets), tuple(controls)
+    d = cplx.from_complex(diag, qureg.dtype)
+    amps = D.apply_diagonal(qureg.amps, d, n=nsv, targets=targets, controls=controls)
+    if qureg.is_density_matrix:
+        amps = D.apply_diagonal(amps, d, n=nsv, targets=_shift(targets, n),
+                                controls=_shift(controls, n), conj=True)
+    qureg.put(amps)
+
+
+def _apply_gate_x(qureg: Qureg, targets, controls=(), states=()):
+    n = qureg.num_qubits_represented
+    nsv = qureg.num_qubits_in_state_vec
+    targets, controls, states = tuple(targets), tuple(controls), tuple(states)
+    amps = K.apply_x_class(qureg.amps, n=nsv, targets=targets,
+                           controls=controls, control_states=states)
+    if qureg.is_density_matrix:
+        amps = K.apply_x_class(amps, n=nsv, targets=_shift(targets, n),
+                               controls=_shift(controls, n), control_states=states)
+    qureg.put(amps)
+
+
+def _apply_gate_parity_phase(qureg: Qureg, theta, qubits, controls=()):
+    n = qureg.num_qubits_represented
+    nsv = qureg.num_qubits_in_state_vec
+    qubits, controls = tuple(qubits), tuple(controls)
+    amps = D.apply_parity_phase(qureg.amps, theta, n=nsv, qubits=qubits, controls=controls)
+    if qureg.is_density_matrix:
+        amps = D.apply_parity_phase(amps, theta, n=nsv, qubits=_shift(qubits, n),
+                                    controls=_shift(controls, n), conj=True)
+    qureg.put(amps)
+
+
+def _record(qureg, gate, targets, controls=(), params=()):
+    if qureg.qasm_log is not None:
+        qureg.qasm_log.record_gate(gate, targets, controls, params)
+
+
+# ---------------------------------------------------------------------------
+# phase gates (diagonal family)
+# ---------------------------------------------------------------------------
+
+def phaseShift(qureg: Qureg, target: int, angle: float) -> None:
+    """diag(1, e^{i angle}) on target (QuEST.h:1916)."""
+    V.validate_target(qureg, target, "phaseShift")
+    _apply_gate_diag(qureg, matrices.phase_shift_diag(angle), (target,))
+    _record(qureg, "phaseShift", (target,), params=(angle,))
+
+
+def controlledPhaseShift(qureg: Qureg, q1: int, q2: int, angle: float) -> None:
+    """Symmetric two-qubit phase (QuEST.h:1965)."""
+    V.validate_control_target(qureg, q1, q2, "controlledPhaseShift")
+    _apply_gate_diag(qureg, matrices.phase_shift_diag(angle), (q2,), (q1,))
+    _record(qureg, "phaseShift", (q2,), (q1,), params=(angle,))
+
+
+def multiControlledPhaseShift(qureg: Qureg, qubits, angle: float) -> None:
+    """Phase on the all-ones subspace of ``qubits`` (QuEST.h:2012)."""
+    V.validate_multi_targets(qureg, qubits, "multiControlledPhaseShift")
+    _apply_gate_diag(qureg, matrices.phase_shift_diag(angle), (qubits[0],), tuple(qubits[1:]))
+    _record(qureg, "phaseShift", (qubits[0],), tuple(qubits[1:]), params=(angle,))
+
+
+def controlledPhaseFlip(qureg: Qureg, q1: int, q2: int) -> None:
+    V.validate_control_target(qureg, q1, q2, "controlledPhaseFlip")
+    _apply_gate_diag(qureg, np.array([1.0, -1.0]), (q2,), (q1,))
+    _record(qureg, "sigmaZ", (q2,), (q1,))
+
+
+def multiControlledPhaseFlip(qureg: Qureg, qubits) -> None:
+    V.validate_multi_targets(qureg, qubits, "multiControlledPhaseFlip")
+    _apply_gate_diag(qureg, np.array([1.0, -1.0]), (qubits[0],), tuple(qubits[1:]))
+    _record(qureg, "sigmaZ", (qubits[0],), tuple(qubits[1:]))
+
+
+def sGate(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "sGate")
+    _apply_gate_diag(qureg, np.array([1.0, 1.0j]), (target,))
+    _record(qureg, "sGate", (target,))
+
+
+def tGate(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "tGate")
+    _apply_gate_diag(qureg, np.array([1.0, np.exp(0.25j * math.pi)]), (target,))
+    _record(qureg, "tGate", (target,))
+
+
+def pauliZ(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "pauliZ")
+    _apply_gate_diag(qureg, np.array([1.0, -1.0]), (target,))
+    _record(qureg, "sigmaZ", (target,))
+
+
+def rotateZ(qureg: Qureg, target: int, angle: float) -> None:
+    V.validate_target(qureg, target, "rotateZ")
+    _apply_gate_diag(qureg, matrices.rz_diag(angle), (target,))
+    _record(qureg, "rotateZ", (target,), params=(angle,))
+
+
+def controlledRotateZ(qureg: Qureg, control: int, target: int, angle: float) -> None:
+    V.validate_control_target(qureg, control, target, "controlledRotateZ")
+    _apply_gate_diag(qureg, matrices.rz_diag(angle), (target,), (control,))
+    _record(qureg, "rotateZ", (target,), (control,), params=(angle,))
+
+
+def multiRotateZ(qureg: Qureg, qubits, angle: float) -> None:
+    """exp(-i angle/2 Z x...x Z) (QuEST.h:4483)."""
+    V.validate_multi_targets(qureg, qubits, "multiRotateZ")
+    _apply_gate_parity_phase(qureg, angle, tuple(qubits))
+    _record(qureg, "multiRotateZ", tuple(qubits), params=(angle,))
+
+
+def multiControlledMultiRotateZ(qureg: Qureg, controls, targets, angle: float) -> None:
+    """(QuEST.h:4616)."""
+    V.validate_multi_controls_multi_targets(qureg, controls, targets, "multiControlledMultiRotateZ")
+    _apply_gate_parity_phase(qureg, angle, tuple(targets), tuple(controls))
+    _record(qureg, "multiRotateZ", tuple(targets), tuple(controls), params=(angle,))
+
+
+def diagonalUnitary(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
+    """Apply a SubDiagonalOp as a unitary (diagonalUnitary, QuEST.h:1444)."""
+    func = "diagonalUnitary"
+    V.validate_multi_targets(qureg, targets, func)
+    V._assert(op.num_qubits == len(targets),
+              "The diagonal operator must act upon the same number of qubits as specified.", func)
+    elems = np.asarray(op.elems)
+    V._assert(bool(np.all(np.abs(np.abs(elems) - 1) < 100 * qureg.eps)),
+              "The diagonal operator is not unitary.", func)
+    _apply_gate_diag(qureg, elems, tuple(targets))
+    _record(qureg, "diagonal", tuple(targets))
+
+
+# ---------------------------------------------------------------------------
+# X-class (amplitude permutation) gates
+# ---------------------------------------------------------------------------
+
+def pauliX(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "pauliX")
+    _apply_gate_x(qureg, (target,))
+    _record(qureg, "sigmaX", (target,))
+
+
+def controlledNot(qureg: Qureg, control: int, target: int) -> None:
+    V.validate_control_target(qureg, control, target, "controlledNot")
+    _apply_gate_x(qureg, (target,), (control,))
+    _record(qureg, "sigmaX", (target,), (control,))
+
+
+def multiQubitNot(qureg: Qureg, targets) -> None:
+    """(QuEST.h:3464)."""
+    V.validate_multi_targets(qureg, targets, "multiQubitNot")
+    _apply_gate_x(qureg, tuple(targets))
+    _record(qureg, "sigmaX", tuple(targets))
+
+
+def multiControlledMultiQubitNot(qureg: Qureg, controls, targets) -> None:
+    """(QuEST.h:3403)."""
+    V.validate_multi_controls_multi_targets(qureg, controls, targets,
+                                            "multiControlledMultiQubitNot")
+    _apply_gate_x(qureg, tuple(targets), tuple(controls))
+    _record(qureg, "sigmaX", tuple(targets), tuple(controls))
+
+
+# ---------------------------------------------------------------------------
+# dense 1-qubit gates
+# ---------------------------------------------------------------------------
+
+def hadamard(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "hadamard")
+    _apply_gate_matrix(qureg, matrices.HADAMARD, (target,))
+    _record(qureg, "hadamard", (target,))
+
+
+def pauliY(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "pauliY")
+    _apply_gate_matrix(qureg, matrices.PAULI_Y_M, (target,))
+    _record(qureg, "sigmaY", (target,))
+
+
+def controlledPauliY(qureg: Qureg, control: int, target: int) -> None:
+    V.validate_control_target(qureg, control, target, "controlledPauliY")
+    _apply_gate_matrix(qureg, matrices.PAULI_Y_M, (target,), (control,))
+    _record(qureg, "sigmaY", (target,), (control,))
+
+
+def compactUnitary(qureg: Qureg, target: int, alpha: complex, beta: complex) -> None:
+    """[[alpha, -conj(beta)], [beta, conj(alpha)]] (QuEST.h:2562)."""
+    func = "compactUnitary"
+    V.validate_target(qureg, target, func)
+    V.validate_unitary_complex_pair(alpha, beta, qureg.eps, func)
+    _apply_gate_matrix(qureg, matrices.compact_unitary_matrix(alpha, beta), (target,))
+    _record(qureg, "unitary", (target,))
+
+
+def controlledCompactUnitary(qureg: Qureg, control: int, target: int,
+                             alpha: complex, beta: complex) -> None:
+    func = "controlledCompactUnitary"
+    V.validate_control_target(qureg, control, target, func)
+    V.validate_unitary_complex_pair(alpha, beta, qureg.eps, func)
+    _apply_gate_matrix(qureg, matrices.compact_unitary_matrix(alpha, beta),
+                       (target,), (control,))
+    _record(qureg, "unitary", (target,), (control,))
+
+
+def unitary(qureg: Qureg, target: int, u) -> None:
+    func = "unitary"
+    V.validate_target(qureg, target, func)
+    V.validate_unitary_matrix(u, 1, qureg.eps, func)
+    _apply_gate_matrix(qureg, u, (target,))
+    _record(qureg, "unitary", (target,))
+
+
+def controlledUnitary(qureg: Qureg, control: int, target: int, u) -> None:
+    func = "controlledUnitary"
+    V.validate_control_target(qureg, control, target, func)
+    V.validate_unitary_matrix(u, 1, qureg.eps, func)
+    _apply_gate_matrix(qureg, u, (target,), (control,))
+    _record(qureg, "unitary", (target,), (control,))
+
+
+def multiControlledUnitary(qureg: Qureg, controls, target: int, u) -> None:
+    func = "multiControlledUnitary"
+    V.validate_multi_controls_multi_targets(qureg, controls, (target,), func)
+    V.validate_unitary_matrix(u, 1, qureg.eps, func)
+    _apply_gate_matrix(qureg, u, (target,), tuple(controls))
+    _record(qureg, "unitary", (target,), tuple(controls))
+
+
+def multiStateControlledUnitary(qureg: Qureg, controls, states, target: int, u) -> None:
+    """Controls conditioned on given bit values (QuEST.h:4448)."""
+    func = "multiStateControlledUnitary"
+    V.validate_multi_controls_multi_targets(qureg, controls, (target,), func)
+    V.validate_control_state(states, len(controls), func)
+    V.validate_unitary_matrix(u, 1, qureg.eps, func)
+    _apply_gate_matrix(qureg, u, (target,), tuple(controls), tuple(int(s) for s in states))
+    _record(qureg, "unitary", (target,), tuple(controls))
+
+
+# ---------------------------------------------------------------------------
+# rotations
+# ---------------------------------------------------------------------------
+
+def rotateX(qureg: Qureg, target: int, angle: float) -> None:
+    V.validate_target(qureg, target, "rotateX")
+    _apply_gate_matrix(qureg, matrices.rx_matrix(angle), (target,))
+    _record(qureg, "rotateX", (target,), params=(angle,))
+
+
+def rotateY(qureg: Qureg, target: int, angle: float) -> None:
+    V.validate_target(qureg, target, "rotateY")
+    _apply_gate_matrix(qureg, matrices.ry_matrix(angle), (target,))
+    _record(qureg, "rotateY", (target,), params=(angle,))
+
+
+def rotateAroundAxis(qureg: Qureg, target: int, angle: float, axis: Vector) -> None:
+    func = "rotateAroundAxis"
+    V.validate_target(qureg, target, func)
+    V.validate_vector(axis, func)
+    _apply_gate_matrix(qureg, matrices.rotation_matrix(angle, axis), (target,))
+    _record(qureg, "unitary", (target,))
+
+
+def controlledRotateX(qureg: Qureg, control: int, target: int, angle: float) -> None:
+    V.validate_control_target(qureg, control, target, "controlledRotateX")
+    _apply_gate_matrix(qureg, matrices.rx_matrix(angle), (target,), (control,))
+    _record(qureg, "rotateX", (target,), (control,), params=(angle,))
+
+
+def controlledRotateY(qureg: Qureg, control: int, target: int, angle: float) -> None:
+    V.validate_control_target(qureg, control, target, "controlledRotateY")
+    _apply_gate_matrix(qureg, matrices.ry_matrix(angle), (target,), (control,))
+    _record(qureg, "rotateY", (target,), (control,), params=(angle,))
+
+
+def controlledRotateAroundAxis(qureg: Qureg, control: int, target: int,
+                               angle: float, axis: Vector) -> None:
+    func = "controlledRotateAroundAxis"
+    V.validate_control_target(qureg, control, target, func)
+    V.validate_vector(axis, func)
+    _apply_gate_matrix(qureg, matrices.rotation_matrix(angle, axis), (target,), (control,))
+    _record(qureg, "unitary", (target,), (control,))
+
+
+def multiRotatePauli(qureg: Qureg, targets, paulis, angle: float) -> None:
+    """exp(-i angle/2 P1 x P2 x ...) via basis rotation to Z then multiRotateZ
+    (statevec_multiRotatePauli, QuEST_common.c:410-488)."""
+    func = "multiRotatePauli"
+    _multi_rotate_pauli(qureg, (), targets, paulis, angle, func)
+
+
+def multiControlledMultiRotatePauli(qureg: Qureg, controls, targets, paulis,
+                                    angle: float) -> None:
+    """(QuEST.h:4726)."""
+    func = "multiControlledMultiRotatePauli"
+    _multi_rotate_pauli(qureg, tuple(controls), targets, paulis, angle, func)
+
+
+def _multi_rotate_pauli(qureg, controls, targets, paulis, angle, func):
+    V.validate_multi_controls_multi_targets(qureg, controls, targets, func)
+    V.validate_num_pauli_codes(paulis, len(targets), func)
+    codes = [int(p) for p in paulis]
+    # identity Paulis are dropped from the Z-product (reference behaviour)
+    active = [(t, c) for t, c in zip(targets, codes) if c != 0]
+    if not active:
+        # global phase exp(-i angle/2) on the controlled subspace
+        if controls:
+            _apply_gate_diag(qureg, np.array([1.0, np.exp(-0.5j * angle)]),
+                             (controls[0],), tuple(controls[1:]))
+        else:
+            _apply_gate_diag(qureg, np.full(2, np.exp(-0.5j * angle)), (targets[0],))
+        return
+    for t, c in active:
+        if c in matrices.BASIS_TO_Z:
+            _apply_gate_matrix(qureg, matrices.BASIS_TO_Z[c], (t,))
+    _apply_gate_parity_phase(qureg, angle, tuple(t for t, _ in active), tuple(controls))
+    for t, c in active:
+        if c in matrices.BASIS_TO_Z:
+            _apply_gate_matrix(qureg, np.conj(matrices.BASIS_TO_Z[c]).T, (t,))
+    _record(qureg, "multiRotatePauli", tuple(targets), tuple(controls), params=(angle,))
+
+
+# ---------------------------------------------------------------------------
+# swaps and multi-qubit unitaries
+# ---------------------------------------------------------------------------
+
+def swapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
+    """(QuEST.h:4331); axis transposition, see ops.apply.apply_swap."""
+    V.validate_unique_targets(qureg, qb1, qb2, "swapGate")
+    n = qureg.num_qubits_represented
+    nsv = qureg.num_qubits_in_state_vec
+    amps = K.apply_swap(qureg.amps, n=nsv, qb1=qb1, qb2=qb2)
+    if qureg.is_density_matrix:
+        amps = K.apply_swap(amps, n=nsv, qb1=qb1 + n, qb2=qb2 + n)
+    qureg.put(amps)
+    _record(qureg, "swap", (qb1, qb2))
+
+
+def sqrtSwapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
+    V.validate_unique_targets(qureg, qb1, qb2, "sqrtSwapGate")
+    _apply_gate_matrix(qureg, matrices.SQRT_SWAP, (qb1, qb2))
+    _record(qureg, "sqrtSwap", (qb1, qb2))
+
+
+def twoQubitUnitary(qureg: Qureg, t1: int, t2: int, u) -> None:
+    """(QuEST.h:4945). Matrix rows ordered with t1 as the least-significant bit."""
+    func = "twoQubitUnitary"
+    V.validate_multi_targets(qureg, (t1, t2), func)
+    V.validate_unitary_matrix(u, 2, qureg.eps, func)
+    _apply_gate_matrix(qureg, u, (t1, t2))
+    _record(qureg, "unitary", (t1, t2))
+
+
+def controlledTwoQubitUnitary(qureg: Qureg, control: int, t1: int, t2: int, u) -> None:
+    func = "controlledTwoQubitUnitary"
+    V.validate_multi_controls_multi_targets(qureg, (control,), (t1, t2), func)
+    V.validate_unitary_matrix(u, 2, qureg.eps, func)
+    _apply_gate_matrix(qureg, u, (t1, t2), (control,))
+    _record(qureg, "unitary", (t1, t2), (control,))
+
+
+def multiControlledTwoQubitUnitary(qureg: Qureg, controls, t1: int, t2: int, u) -> None:
+    func = "multiControlledTwoQubitUnitary"
+    V.validate_multi_controls_multi_targets(qureg, controls, (t1, t2), func)
+    V.validate_unitary_matrix(u, 2, qureg.eps, func)
+    _apply_gate_matrix(qureg, u, (t1, t2), tuple(controls))
+    _record(qureg, "unitary", (t1, t2), tuple(controls))
+
+
+def multiQubitUnitary(qureg: Qureg, targets, u) -> None:
+    """General dense unitary (QuEST.h:5193); the kernel every gate reduces to."""
+    func = "multiQubitUnitary"
+    V.validate_multi_targets(qureg, targets, func)
+    V.validate_unitary_matrix(u, len(targets), qureg.eps, func)
+    _apply_gate_matrix(qureg, u, tuple(targets))
+    _record(qureg, "unitary", tuple(targets))
+
+
+def controlledMultiQubitUnitary(qureg: Qureg, control: int, targets, u) -> None:
+    func = "controlledMultiQubitUnitary"
+    V.validate_multi_controls_multi_targets(qureg, (control,), targets, func)
+    V.validate_unitary_matrix(u, len(targets), qureg.eps, func)
+    _apply_gate_matrix(qureg, u, tuple(targets), (control,))
+    _record(qureg, "unitary", tuple(targets), (control,))
+
+
+def multiControlledMultiQubitUnitary(qureg: Qureg, controls, targets, u) -> None:
+    """(QuEST.h:5366; reference dispatch QuEST_cpu_distributed.c:1526-1568)."""
+    func = "multiControlledMultiQubitUnitary"
+    V.validate_multi_controls_multi_targets(qureg, controls, targets, func)
+    V.validate_unitary_matrix(u, len(targets), qureg.eps, func)
+    _apply_gate_matrix(qureg, u, tuple(targets), tuple(controls))
+    _record(qureg, "unitary", tuple(targets), tuple(controls))
+
+
+# ---------------------------------------------------------------------------
+# measurement (QuEST.h:3544-3719; logic QuEST_common.c:360-366)
+# ---------------------------------------------------------------------------
+
+def _prob_of_outcome(qureg: Qureg, target: int, outcome: int) -> float:
+    nsv = qureg.num_qubits_in_state_vec
+    if qureg.is_density_matrix:
+        p = M.density_prob_of_outcome(qureg.amps, n=qureg.num_qubits_represented,
+                                      target=target, outcome=outcome)
+    else:
+        p = M.prob_of_outcome(qureg.amps, n=nsv, target=target, outcome=outcome)
+    return float(p)
+
+
+def _collapse(qureg: Qureg, target: int, outcome: int, prob: float) -> None:
+    nsv = qureg.num_qubits_in_state_vec
+    if qureg.is_density_matrix:
+        amps = M.density_collapse(qureg.amps, prob, n=qureg.num_qubits_represented,
+                                  target=target, outcome=outcome)
+    else:
+        amps = M.collapse_statevec(qureg.amps, prob, n=nsv, target=target, outcome=outcome)
+    qureg.put(amps)
+
+
+def collapseToOutcome(qureg: Qureg, target: int, outcome: int) -> float:
+    """Force a measurement outcome; returns its probability (QuEST.h:3668)."""
+    func = "collapseToOutcome"
+    V.validate_target(qureg, target, func)
+    V.validate_outcome(outcome, func)
+    prob = _prob_of_outcome(qureg, target, outcome)
+    V._assert(prob > qureg.eps, "Can't collapse to state with zero probability.", func)
+    _collapse(qureg, target, outcome, prob)
+    if qureg.qasm_log is not None:
+        qureg.qasm_log.record_comment(f"collapseToOutcome {outcome} on q[{target}]")
+    return prob
+
+
+def measureWithStats(qureg: Qureg, target: int):
+    """Random measurement; returns (outcome, its probability) (QuEST.h:3719).
+
+    The random draw uses the env's host Mersenne Twister so results are
+    reproducible under seedQuEST, as generateMeasurementOutcome
+    (QuEST_common.c:168-183).
+    """
+    V.validate_target(qureg, target, "measureWithStats")
+    zero_prob = _prob_of_outcome(qureg, target, 0)
+    # generateMeasurementOutcome: draw in [0,1), outcome 1 iff draw >= P(0)
+    draw = qureg.env.rng.random_sample() if qureg.env.rng is not None else np.random.random()
+    if zero_prob < 1e-16:
+        outcome, prob = 1, 1 - zero_prob
+    elif zero_prob > 1 - 1e-16:
+        outcome, prob = 0, zero_prob
+    else:
+        outcome = int(draw >= zero_prob)
+        prob = zero_prob if outcome == 0 else 1 - zero_prob
+    _collapse(qureg, target, outcome, prob)
+    if qureg.qasm_log is not None:
+        qureg.qasm_log.record_measurement(target)
+    return outcome, prob
+
+
+def measure(qureg: Qureg, target: int) -> int:
+    """(QuEST.h:3693)."""
+    V.validate_target(qureg, target, "measure")
+    outcome, _ = measureWithStats(qureg, target)
+    return outcome

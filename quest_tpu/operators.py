@@ -1,0 +1,554 @@
+"""Operators: possibly non-unitary applications and structured operators
+(reference QuEST.h:5688-7421 + DiagonalOp family QuEST.h:1033-1513).
+
+Includes: applyMatrix2/4/N (+Gate/MultiControlled variants), applyPauliSum /
+applyPauliHamil, applyTrotterCircuit, applyFullQFT / applyQFT, the phase
+function family, DiagonalOp / SubDiagonalOp application, applyProjector.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import validation as V
+from .datatypes import (DiagonalOp, PauliHamil, SubDiagonalOp,
+                        pauli_term_matrix, phaseFunc)
+from .ops import apply as K, cplx, diagonal as D, measure as M
+from .ops import phasefunc as PF, reduce as R
+from .registers import Qureg, createCloneQureg
+
+__all__ = [
+    "applyMatrix2", "applyMatrix4", "applyMatrixN", "applyGateMatrixN",
+    "applyMultiControlledMatrixN", "applyMultiControlledGateMatrixN",
+    "applyPauliSum", "applyPauliHamil", "applyTrotterCircuit",
+    "applyFullQFT", "applyQFT", "applyProjector",
+    "applyPhaseFunc", "applyPhaseFuncOverrides",
+    "applyMultiVarPhaseFunc", "applyMultiVarPhaseFuncOverrides",
+    "applyNamedPhaseFunc", "applyNamedPhaseFuncOverrides",
+    "applyParamNamedPhaseFunc", "applyParamNamedPhaseFuncOverrides",
+    "createDiagonalOp", "destroyDiagonalOp", "syncDiagonalOp",
+    "initDiagonalOp", "setDiagonalOpElems", "initDiagonalOpFromPauliHamil",
+    "createDiagonalOpFromPauliHamilFile", "applyDiagonalOp",
+    "calcExpecDiagonalOp", "applySubDiagonalOp", "applyGateSubDiagonalOp",
+    "setQuregToPauliHamil",
+]
+
+
+def _record(qureg, text):
+    if qureg.qasm_log is not None:
+        qureg.qasm_log.record_comment(text)
+
+
+# ---------------------------------------------------------------------------
+# direct (non-unitary) matrix application: left-multiplies a density matrix
+# (no conj-shadow), unlike the Gate variants (QuEST.h:5892-6147)
+# ---------------------------------------------------------------------------
+
+def _apply_matrix_left(qureg: Qureg, matrix, targets, controls=()):
+    """M|psi> or M.rho (left multiplication only)."""
+    nsv = qureg.num_qubits_in_state_vec
+    m = cplx.from_complex(matrix, qureg.dtype)
+    qureg.put(K.apply_matrix(qureg.amps, m, n=nsv, targets=tuple(targets),
+                             controls=tuple(controls)))
+
+
+def _apply_matrix_gate(qureg: Qureg, matrix, targets, controls=()):
+    """M|psi> or M.rho.M^dagger (the Gate variants)."""
+    n = qureg.num_qubits_represented
+    nsv = qureg.num_qubits_in_state_vec
+    m = cplx.from_complex(matrix, qureg.dtype)
+    amps = K.apply_matrix(qureg.amps, m, n=nsv, targets=tuple(targets),
+                          controls=tuple(controls))
+    if qureg.is_density_matrix:
+        amps = K.apply_matrix(amps, m, n=nsv,
+                              targets=tuple(q + n for q in targets),
+                              controls=tuple(c + n for c in controls), conj=True)
+    qureg.put(amps)
+
+
+def applyMatrix2(qureg: Qureg, target: int, u) -> None:
+    """(QuEST.h:5892)."""
+    func = "applyMatrix2"
+    V.validate_target(qureg, target, func)
+    V.validate_matrix_size(u, 1, func)
+    _apply_matrix_left(qureg, u, (target,))
+    _record(qureg, "applyMatrix2")
+
+
+def applyMatrix4(qureg: Qureg, t1: int, t2: int, u) -> None:
+    func = "applyMatrix4"
+    V.validate_multi_targets(qureg, (t1, t2), func)
+    V.validate_matrix_size(u, 2, func)
+    _apply_matrix_left(qureg, u, (t1, t2))
+    _record(qureg, "applyMatrix4")
+
+
+def applyMatrixN(qureg: Qureg, targets, u) -> None:
+    func = "applyMatrixN"
+    V.validate_multi_targets(qureg, targets, func)
+    V.validate_matrix_size(u, len(targets), func)
+    _apply_matrix_left(qureg, u, tuple(targets))
+    _record(qureg, "applyMatrixN")
+
+
+def applyGateMatrixN(qureg: Qureg, targets, u) -> None:
+    """Applies M (and M^dagger on the bra side of a density matrix) without
+    requiring unitarity (QuEST.h:6043)."""
+    func = "applyGateMatrixN"
+    V.validate_multi_targets(qureg, targets, func)
+    V.validate_matrix_size(u, len(targets), func)
+    _apply_matrix_gate(qureg, u, tuple(targets))
+    _record(qureg, "applyGateMatrixN")
+
+
+def applyMultiControlledMatrixN(qureg: Qureg, controls, targets, u) -> None:
+    func = "applyMultiControlledMatrixN"
+    V.validate_multi_controls_multi_targets(qureg, controls, targets, func)
+    V.validate_matrix_size(u, len(targets), func)
+    _apply_matrix_left(qureg, u, tuple(targets), tuple(controls))
+    _record(qureg, "applyMultiControlledMatrixN")
+
+
+def applyMultiControlledGateMatrixN(qureg: Qureg, controls, targets, u) -> None:
+    """(QuEST.h:6094)."""
+    func = "applyMultiControlledGateMatrixN"
+    V.validate_multi_controls_multi_targets(qureg, controls, targets, func)
+    V.validate_matrix_size(u, len(targets), func)
+    _apply_matrix_gate(qureg, u, tuple(targets), tuple(controls))
+    _record(qureg, "applyMultiControlledGateMatrixN")
+
+
+# ---------------------------------------------------------------------------
+# Pauli sums and Hamiltonians (statevec_applyPauliSum, QuEST_common.c:534-555)
+# ---------------------------------------------------------------------------
+
+def applyPauliSum(in_qureg: Qureg, all_pauli_codes, term_coeffs, out_qureg: Qureg) -> None:
+    """out = sum_t c_t P_t |in> (QuEST.h:5747). Matches the reference's
+    apply-undo loop semantics (in_qureg is restored)."""
+    func = "applyPauliSum"
+    codes = np.asarray(all_pauli_codes, dtype=np.int32).reshape(len(term_coeffs), -1)
+    V._assert(codes.size == len(term_coeffs) * in_qureg.num_qubits_represented,
+              "Invalid number of Pauli codes. The number of codes must equal numQubits * numSumTerms.",
+              func)
+    V.validate_pauli_codes(codes.ravel(), func)
+    V.validate_matching_qureg_types(in_qureg, out_qureg, func)
+    V.validate_matching_qureg_dims(in_qureg, out_qureg, func)
+    _apply_pauli_sum(in_qureg, codes, term_coeffs, out_qureg)
+    _record(out_qureg, "applyPauliSum")
+
+
+def applyPauliHamil(in_qureg: Qureg, hamil: PauliHamil, out_qureg: Qureg) -> None:
+    """(QuEST.h:5791)."""
+    func = "applyPauliHamil"
+    V.validate_pauli_hamil(hamil, func)
+    V.validate_hamil_matches_qureg(in_qureg, hamil, func)
+    V.validate_matching_qureg_types(in_qureg, out_qureg, func)
+    V.validate_matching_qureg_dims(in_qureg, out_qureg, func)
+    _apply_pauli_sum(in_qureg, hamil.pauli_codes, hamil.term_coeffs, out_qureg)
+    _record(out_qureg, "applyPauliHamil")
+
+
+def _apply_pauli_sum(in_qureg, codes, coeffs, out_qureg):
+    from .calculations import _apply_pauli_prod
+    n = in_qureg.num_qubits_represented
+    targets = list(range(n))
+    out_amps = jnp.zeros_like(in_qureg.amps)
+    work = createCloneQureg(in_qureg, in_qureg.env)
+    for t in range(codes.shape[0]):
+        work.put(in_qureg.amps + 0)
+        _apply_pauli_prod(work, targets, codes[t])
+        c = float(coeffs[t])
+        out_amps = out_amps + c * work.amps
+    out_qureg.put(out_amps)
+
+
+def applyTrotterCircuit(qureg: Qureg, hamil: PauliHamil, time: float,
+                        order: int, reps: int) -> None:
+    """Symmetrised Trotter-Suzuki evolution e^{-iHt}
+    (agnostic_applyTrotterCircuit, QuEST_common.c:762-844)."""
+    func = "applyTrotterCircuit"
+    V.validate_pauli_hamil(hamil, func)
+    V.validate_hamil_matches_qureg(qureg, hamil, func)
+    V.validate_trotter_params(order, reps, func)
+    was_recording = qureg.qasm_log.recording if qureg.qasm_log else False
+    if qureg.qasm_log:
+        qureg.qasm_log.recording = False
+    for _ in range(reps):
+        _trotter_cycle(qureg, hamil, time / reps, order)
+    if qureg.qasm_log:
+        qureg.qasm_log.recording = was_recording
+    _record(qureg, f"applyTrotterCircuit(t={time:g}, order={order}, reps={reps})")
+
+
+def _first_order_trotter(qureg, hamil, time, reverse):
+    from .gates import multiRotatePauli
+    terms = range(hamil.num_sum_terms)
+    if reverse:
+        terms = reversed(list(terms))
+    targets = list(range(hamil.num_qubits))
+    for t in terms:
+        angle = 2 * float(hamil.term_coeffs[t]) * time
+        multiRotatePauli(qureg, targets, hamil.pauli_codes[t], angle)
+
+
+def _trotter_cycle(qureg, hamil, time, order):
+    # recursion of agnostic_applyTrotterCircuit (QuEST_common.c:800-844)
+    if order == 1:
+        _first_order_trotter(qureg, hamil, time, False)
+    elif order == 2:
+        _first_order_trotter(qureg, hamil, time / 2, False)
+        _first_order_trotter(qureg, hamil, time / 2, True)
+    else:
+        p = 1.0 / (4 - 4 ** (1.0 / (order - 1)))
+        _trotter_cycle(qureg, hamil, p * time, order - 2)
+        _trotter_cycle(qureg, hamil, p * time, order - 2)
+        _trotter_cycle(qureg, hamil, (1 - 4 * p) * time, order - 2)
+        _trotter_cycle(qureg, hamil, p * time, order - 2)
+        _trotter_cycle(qureg, hamil, p * time, order - 2)
+
+
+def setQuregToPauliHamil(qureg: Qureg, hamil: PauliHamil) -> None:
+    """rho = H as a dense operator (QuEST.h:1854; densmatr_setQuregToPauliHamil).
+
+    Built on device by a progressive Kronecker expansion of each term."""
+    func = "setQuregToPauliHamil"
+    V.validate_density_matr(qureg, func)
+    V.validate_pauli_hamil(hamil, func)
+    V.validate_hamil_matches_qureg(qureg, hamil, func)
+    n = qureg.num_qubits_represented
+    acc = np.zeros((2 ** n, 2 ** n), dtype=np.complex128)
+    for t in range(hamil.num_sum_terms):
+        acc += hamil.term_coeffs[t] * pauli_term_matrix(hamil.pauli_codes[t])
+    # element rho[r, c] at flat index c*2^n + r -> [col, row] = acc.T
+    from .state_init import _put_shaped
+    _put_shaped(qureg, cplx.from_complex(acc.T.reshape(-1), qureg.dtype))
+
+
+# ---------------------------------------------------------------------------
+# QFT (agnostic_applyQFT, QuEST_common.c:846-908)
+# ---------------------------------------------------------------------------
+
+def _qft_on(qureg: Qureg, qubits) -> None:
+    from .gates import controlledPhaseShift, hadamard, swapGate
+    m = len(qubits)
+    # textbook QFT: H + controlled phases, then qubit-order reversal
+    for j in reversed(range(m)):
+        hadamard(qureg, qubits[j])
+        for k in range(j):
+            angle = math.pi / (1 << (j - k))
+            controlledPhaseShift(qureg, qubits[k], qubits[j], angle)
+    for j in range(m // 2):
+        swapGate(qureg, qubits[j], qubits[m - 1 - j])
+
+
+def applyFullQFT(qureg: Qureg) -> None:
+    """QFT on every qubit (QuEST.h:7277)."""
+    was = qureg.qasm_log.recording if qureg.qasm_log else False
+    if qureg.qasm_log:
+        qureg.qasm_log.recording = False
+    _qft_on(qureg, list(range(qureg.num_qubits_represented)))
+    if qureg.qasm_log:
+        qureg.qasm_log.recording = was
+    _record(qureg, "applyFullQFT")
+
+
+def applyQFT(qureg: Qureg, qubits) -> None:
+    """QFT on a qubit subset (QuEST.h:7397)."""
+    func = "applyQFT"
+    V.validate_multi_targets(qureg, qubits, func)
+    was = qureg.qasm_log.recording if qureg.qasm_log else False
+    if qureg.qasm_log:
+        qureg.qasm_log.recording = False
+    _qft_on(qureg, list(qubits))
+    if qureg.qasm_log:
+        qureg.qasm_log.recording = was
+    _record(qureg, f"applyQFT on {list(qubits)}")
+
+
+def applyProjector(qureg: Qureg, target: int, outcome: int) -> None:
+    """Unnormalised projection |outcome><outcome| on target (QuEST.h:7421)."""
+    func = "applyProjector"
+    V.validate_target(qureg, target, func)
+    V.validate_outcome(outcome, func)
+    n = qureg.num_qubits_represented
+    nsv = qureg.num_qubits_in_state_vec
+    amps = M.project_statevec(qureg.amps, n=nsv, target=target, outcome=outcome)
+    if qureg.is_density_matrix:
+        amps = M.project_statevec(amps, n=nsv, target=target + n, outcome=outcome)
+    qureg.put(amps)
+    _record(qureg, f"applyProjector({outcome}) on q[{target}]")
+
+
+# ---------------------------------------------------------------------------
+# phase functions (QuEST.h:6407-7179; kernels in ops.phasefunc)
+# ---------------------------------------------------------------------------
+
+def _phase_func_apply(qureg, qubits_flat, reg_sizes, encoding, coeffs, exponents,
+                      terms_per_reg, override_inds, override_phases, func):
+    for m, off in zip(reg_sizes, np.cumsum([0] + list(reg_sizes))[:-1]):
+        V.validate_multi_targets(qureg, qubits_flat[off:off + m], func)
+    n_ovr = len(override_phases)
+    V.validate_phase_func_overrides(reg_sizes, encoding, override_inds, n_ovr, func)
+    nsv = qureg.num_qubits_in_state_vec
+    n = qureg.num_qubits_represented
+    dt = qureg.dtype
+    args = dict(
+        reg_sizes=tuple(int(m) for m in reg_sizes),
+        encoding=int(encoding),
+        exponents=tuple(float(e) for e in exponents),
+        num_terms_per_reg=tuple(int(t) for t in terms_per_reg),
+        num_overrides=n_ovr,
+    )
+    coeffs_d = jnp.asarray(np.asarray(coeffs, dtype=np.float64), dtype=dt)
+    ovr_i = jnp.asarray(np.asarray(override_inds, dtype=np.float64), dtype=dt)
+    ovr_p = jnp.asarray(np.asarray(override_phases, dtype=np.float64), dtype=dt)
+    amps = PF.apply_poly_phase(qureg.amps, coeffs_d, ovr_i, ovr_p,
+                               n=nsv, qubits=tuple(int(q) for q in qubits_flat),
+                               conj=False, **args)
+    if qureg.is_density_matrix:
+        shifted = tuple(int(q) + n for q in qubits_flat)
+        amps = PF.apply_poly_phase(amps, coeffs_d, ovr_i, ovr_p,
+                                   n=nsv, qubits=shifted, conj=True, **args)
+    qureg.put(amps)
+    _record(qureg, func)
+
+
+def applyPhaseFunc(qureg: Qureg, qubits, encoding, coeffs, exponents) -> None:
+    """phase(r) = sum_t coeffs[t] r^exponents[t] on the sub-register value r
+    (QuEST.h:6407)."""
+    applyPhaseFuncOverrides(qureg, qubits, encoding, coeffs, exponents, [], [])
+
+
+def applyPhaseFuncOverrides(qureg: Qureg, qubits, encoding, coeffs, exponents,
+                            override_inds, override_phases) -> None:
+    """(QuEST.h:6518)."""
+    func = "applyPhaseFuncOverrides"
+    V._assert(len(coeffs) == len(exponents) and len(coeffs) > 0,
+              "Invalid number of terms in the phase function.", func)
+    _phase_func_apply(qureg, list(qubits), [len(qubits)], encoding, coeffs,
+                      exponents, [len(coeffs)], override_inds, override_phases, func)
+
+
+def applyMultiVarPhaseFunc(qureg: Qureg, qubits_flat, num_qubits_per_reg, encoding,
+                           coeffs, exponents, num_terms_per_reg) -> None:
+    """(QuEST.h:6679)."""
+    applyMultiVarPhaseFuncOverrides(qureg, qubits_flat, num_qubits_per_reg, encoding,
+                                    coeffs, exponents, num_terms_per_reg, [], [])
+
+
+def applyMultiVarPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_reg,
+                                    encoding, coeffs, exponents, num_terms_per_reg,
+                                    override_inds, override_phases) -> None:
+    """(QuEST.h:6761)."""
+    func = "applyMultiVarPhaseFuncOverrides"
+    V._assert(len(num_qubits_per_reg) > 0, "Invalid number of qubit sub-registers.", func)
+    V._assert(sum(num_terms_per_reg) == len(coeffs) == len(exponents),
+              "Invalid number of terms in the phase function.", func)
+    _phase_func_apply(qureg, list(qubits_flat), list(num_qubits_per_reg), encoding,
+                      coeffs, exponents, list(num_terms_per_reg),
+                      override_inds, override_phases, func)
+
+
+def applyNamedPhaseFunc(qureg: Qureg, qubits_flat, num_qubits_per_reg, encoding,
+                        func_name) -> None:
+    """(QuEST.h:6901)."""
+    applyParamNamedPhaseFuncOverrides(qureg, qubits_flat, num_qubits_per_reg,
+                                      encoding, func_name, [], [], [])
+
+
+def applyNamedPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_reg,
+                                 encoding, func_name, override_inds,
+                                 override_phases) -> None:
+    """(QuEST.h:6974)."""
+    applyParamNamedPhaseFuncOverrides(qureg, qubits_flat, num_qubits_per_reg,
+                                      encoding, func_name, [],
+                                      override_inds, override_phases)
+
+
+def applyParamNamedPhaseFunc(qureg: Qureg, qubits_flat, num_qubits_per_reg,
+                             encoding, func_name, params) -> None:
+    """(QuEST.h:7104)."""
+    applyParamNamedPhaseFuncOverrides(qureg, qubits_flat, num_qubits_per_reg,
+                                      encoding, func_name, params, [], [])
+
+
+def applyParamNamedPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_reg,
+                                      encoding, func_name, params,
+                                      override_inds, override_phases) -> None:
+    """(QuEST.h:7179)."""
+    func = "applyParamNamedPhaseFuncOverrides"
+    reg_sizes = [int(m) for m in num_qubits_per_reg]
+    V._assert(len(reg_sizes) > 0, "Invalid number of qubit sub-registers.", func)
+    fn = phaseFunc(int(func_name))
+    if fn in (phaseFunc.DISTANCE, phaseFunc.SCALED_DISTANCE, phaseFunc.INVERSE_DISTANCE,
+              phaseFunc.SCALED_INVERSE_DISTANCE, phaseFunc.SCALED_INVERSE_SHIFTED_DISTANCE,
+              phaseFunc.SCALED_INVERSE_SHIFTED_WEIGHTED_DISTANCE):
+        V._assert(len(reg_sizes) % 2 == 0,
+                  "Phase functions DISTANCE require a paired number of qubit sub-registers.",
+                  func)
+    n_ovr = len(override_phases)
+    V.validate_phase_func_overrides(reg_sizes, encoding, override_inds, n_ovr, func)
+    for m, off in zip(reg_sizes, np.cumsum([0] + reg_sizes)[:-1]):
+        V.validate_multi_targets(qureg, list(qubits_flat)[off:off + m], func)
+
+    nsv = qureg.num_qubits_in_state_vec
+    n = qureg.num_qubits_represented
+    dt = qureg.dtype
+    # pad params so indexed accesses (params[2+r] etc.) are always in range
+    padded = list(map(float, params)) + [0.0] * (2 + 2 * n_regs)
+    params_d = jnp.asarray(padded, dtype=dt)
+    ovr_i = jnp.asarray(np.asarray(override_inds, dtype=np.float64), dtype=dt)
+    ovr_p = jnp.asarray(np.asarray(override_phases, dtype=np.float64), dtype=dt)
+    args = dict(reg_sizes=tuple(reg_sizes), encoding=int(encoding),
+                func_name=int(func_name), num_params=len(params),
+                num_overrides=n_ovr)
+    amps = PF.apply_named_phase(qureg.amps, params_d, ovr_i, ovr_p,
+                                n=nsv, qubits=tuple(int(q) for q in qubits_flat),
+                                conj=False, **args)
+    if qureg.is_density_matrix:
+        shifted = tuple(int(q) + n for q in qubits_flat)
+        amps = PF.apply_named_phase(amps, params_d, ovr_i, ovr_p,
+                                    n=nsv, qubits=shifted, conj=True, **args)
+    qureg.put(amps)
+    _record(qureg, func)
+
+
+# ---------------------------------------------------------------------------
+# DiagonalOp (QuEST.h:1033-1314) -- full 2^N diagonal, sharded like a Qureg
+# ---------------------------------------------------------------------------
+
+def createDiagonalOp(num_qubits: int, env) -> DiagonalOp:
+    func = "createDiagonalOp"
+    V.validate_num_qubits(num_qubits, func)
+    from . import precision
+    dt = precision.real_dtype(None)
+    elems = jnp.zeros((2, 1 << num_qubits), dtype=dt)
+    sharding = env.sharding(1 << num_qubits)
+    if sharding is not None:
+        import jax
+        elems = jax.device_put(elems, sharding)
+    return DiagonalOp(num_qubits, elems)
+
+
+def destroyDiagonalOp(op: DiagonalOp, env=None) -> None:
+    try:
+        op.elems.delete()
+    except Exception:
+        pass
+    op.elems = None
+
+
+def syncDiagonalOp(op: DiagonalOp) -> None:
+    """No-op: elems already live on device (reference copies host->GPU,
+    QuEST_gpu_common.cu:508-640)."""
+
+
+def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
+    func = "initDiagonalOp"
+    reals = np.asarray(reals).reshape(-1)
+    imags = np.asarray(imags).reshape(-1)
+    V._assert(reals.size == (1 << op.num_qubits) and imags.size == (1 << op.num_qubits),
+              "Invalid number of elements.", func)
+    new = jnp.asarray(np.stack([reals, imags]), dtype=op.elems.dtype)
+    # preserve the mesh sharding createDiagonalOp established
+    import jax
+    if hasattr(op.elems, "sharding") and op.elems.sharding is not None:
+        new = jax.device_put(new, op.elems.sharding)
+    op.elems = new
+
+
+def setDiagonalOpElems(op: DiagonalOp, start_ind: int, reals, imags, num_elems: int) -> None:
+    func = "setDiagonalOpElems"
+    V.validate_num_elems(op, start_ind, num_elems, func)
+    vals = np.stack([np.asarray(reals).reshape(-1)[:num_elems],
+                     np.asarray(imags).reshape(-1)[:num_elems]])
+    op.elems = op.elems.at[:, start_ind:start_ind + num_elems].set(
+        jnp.asarray(vals, dtype=op.elems.dtype))
+
+
+def initDiagonalOpFromPauliHamil(op: DiagonalOp, hamil: PauliHamil) -> None:
+    """Hamil of only I/Z terms -> diagonal elements (QuEST.h:1158)."""
+    func = "initDiagonalOpFromPauliHamil"
+    V.validate_pauli_hamil(hamil, func)
+    V._assert(op.num_qubits == hamil.num_qubits,
+              "The PauliHamil must act on the same number of qubits as the DiagonalOp.", func)
+    V._assert(bool(np.all((hamil.pauli_codes == 0) | (hamil.pauli_codes == 3))),
+              "The PauliHamil contained operators other than PAULI_Z and PAULI_I.", func)
+    n = op.num_qubits
+    idx = np.arange(1 << n, dtype=np.int64)
+    diag = np.zeros(1 << n, dtype=np.float64)
+    for t in range(hamil.num_sum_terms):
+        sign = np.ones(1 << n, dtype=np.float64)
+        for q in range(n):
+            if hamil.pauli_codes[t, q] == 3:
+                sign *= 1.0 - 2.0 * ((idx >> q) & 1)
+        diag += hamil.term_coeffs[t] * sign
+    initDiagonalOp(op, diag, np.zeros_like(diag))
+
+
+def createDiagonalOpFromPauliHamilFile(path: str, env) -> DiagonalOp:
+    """(QuEST.h:1201)."""
+    from .datatypes import createPauliHamilFromFile
+    hamil = createPauliHamilFromFile(path)
+    op = createDiagonalOp(hamil.num_qubits, env)
+    initDiagonalOpFromPauliHamil(op, hamil)
+    return op
+
+
+def applyDiagonalOp(qureg: Qureg, op: DiagonalOp) -> None:
+    """|psi> -> D|psi>; rho -> D rho (QuEST.h:1282)."""
+    func = "applyDiagonalOp"
+    V.validate_diag_op_matches_qureg(qureg, op, func)
+    elems = op.elems.astype(qureg.dtype)
+    if qureg.is_density_matrix:
+        qureg.put(D.apply_full_diagonal_to_density(
+            qureg.amps, elems, n=qureg.num_qubits_represented))
+    else:
+        qureg.put(D.apply_full_diagonal(qureg.amps, elems))
+    _record(qureg, "applyDiagonalOp")
+
+
+def calcExpecDiagonalOp(qureg: Qureg, op: DiagonalOp) -> complex:
+    """(QuEST.h:1314)."""
+    func = "calcExpecDiagonalOp"
+    V.validate_diag_op_matches_qureg(qureg, op, func)
+    elems = op.elems.astype(qureg.dtype)
+    if qureg.is_density_matrix:
+        re, im = R.expec_diag_op_density(qureg.amps, elems,
+                                         n=qureg.num_qubits_represented)
+    else:
+        re, im = R.expec_diag_op_statevec(qureg.amps, elems)
+    return complex(float(re), float(im))
+
+
+def applySubDiagonalOp(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
+    """D on a qubit subset, without unitarity checks and without the bra-side
+    shadow (QuEST.h:1513)."""
+    func = "applySubDiagonalOp"
+    V.validate_multi_targets(qureg, targets, func)
+    V._assert(op.num_qubits == len(targets),
+              "The diagonal operator must act upon the same number of qubits as specified.", func)
+    d = cplx.from_complex(np.asarray(op.elems), qureg.dtype)
+    qureg.put(D.apply_diagonal(qureg.amps, d, n=qureg.num_qubits_in_state_vec,
+                               targets=tuple(targets)))
+    _record(qureg, "applySubDiagonalOp")
+
+
+def applyGateSubDiagonalOp(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
+    """D with the conjugated bra-side shadow on density matrices (QuEST.h:1473)."""
+    func = "applyGateSubDiagonalOp"
+    V.validate_multi_targets(qureg, targets, func)
+    V._assert(op.num_qubits == len(targets),
+              "The diagonal operator must act upon the same number of qubits as specified.", func)
+    n = qureg.num_qubits_represented
+    nsv = qureg.num_qubits_in_state_vec
+    d = cplx.from_complex(np.asarray(op.elems), qureg.dtype)
+    amps = D.apply_diagonal(qureg.amps, d, n=nsv, targets=tuple(targets))
+    if qureg.is_density_matrix:
+        amps = D.apply_diagonal(amps, d, n=nsv,
+                                targets=tuple(q + n for q in targets), conj=True)
+    qureg.put(amps)
+    _record(qureg, "applyGateSubDiagonalOp")

@@ -1,0 +1,17 @@
+"""Functional, jit-compiled kernels over raw amplitude arrays.
+
+This package is the TPU-native analogue of the reference's L0/L1 kernel layers
+(``QuEST/src/CPU/QuEST_cpu.c``, ``QuEST/src/GPU/QuEST_gpu.cu``): every function
+is pure (amps in, amps out), shape-static, and safe to compose under ``jax.jit``
+and to run on sharded arrays (XLA's SPMD partitioner inserts the collectives
+the reference hand-codes with MPI).
+
+The index algebra that the reference implements with bit twiddling
+(``QuEST_cpu_internal.h:26-53``) is expressed here as *reshapes*: qubit q of an
+amplitude index is an axis of a grouped tensor view (see :mod:`.layout`), so
+gates become transposes + small matmuls and phase ops become broadcasted
+elementwise multiplies -- both of which XLA maps natively onto the TPU's
+MXU/VPU without materialising index arrays.
+"""
+
+from . import apply, density, diagonal, init, layout, measure, reduce  # noqa: F401

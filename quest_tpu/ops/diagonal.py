@@ -1,0 +1,139 @@
+"""Diagonal / phase-only kernels: no data movement, pure broadcasted multiply.
+
+The reference implements these as mask-parity loops (phaseShiftByTerm
+``QuEST_cpu.c:3113``, multiRotateZ ``QuEST_cpu.c:3235-3285``). On TPU a phase
+gate never needs a transpose: build planar factor tensors that broadcast
+against the grouped view (1-sized everywhere except the touched 2-sized axes)
+and complex-multiply the planes -- XLA fuses the whole thing into one VPU pass
+over HBM, and it works unchanged on sharded arrays (factors are replicated
+scalars).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .layout import grouped_axes
+
+
+def _axis_vec(values, axis: int, rank: int, dtype):
+    """A length-2 vector placed on one broadcast axis (axes count the grouped
+    view only; the planar axis is prepended by callers via [None])."""
+    shape = [1] * rank
+    shape[axis] = 2
+    return jnp.asarray(values, dtype=dtype).reshape(shape)
+
+
+def _control_selector(axis_of, controls, rank, dtype):
+    """Tensor that is 1 where all controls are 1, else 0 (broadcastable)."""
+    sel = None
+    for c in controls:
+        v = _axis_vec([0.0, 1.0], axis_of[c], rank, dtype)
+        sel = v if sel is None else sel * v
+    return sel
+
+
+def _mul_factor(amps, shape, fr, fi):
+    """amps (2, 2^n) times planar factor (fr, fi) broadcast over ``shape``."""
+    t = amps.reshape((2,) + shape)
+    re = t[0] * fr - t[1] * fi
+    im = t[0] * fi + t[1] * fr
+    return jnp.stack([re, im]).reshape(2, -1)
+
+
+@partial(jax.jit, static_argnames=("n", "targets", "controls", "conj"), donate_argnums=(0,))
+def apply_diagonal(amps, diag, *, n: int, targets: tuple[int, ...],
+                   controls: tuple[int, ...] = (), conj: bool = False):
+    """Multiply by a planar (2, 2^t) diagonal on ``targets`` (controls gate it
+    to the all-1 subspace). Index convention matches apply_matrix: targets[0]
+    is the least-significant bit of the diagonal's index.
+
+    Covers phaseShift/sGate/tGate/rotateZ/controlledPhaseFlip/diagonalUnitary/
+    applySubDiagonalOp (reference kernels ``QuEST_cpu.c:1339-1386,3113-3233``).
+    """
+    t = len(targets)
+    shape, axis_of = grouped_axes(n, tuple(targets) + tuple(controls))
+    rank = len(shape)
+
+    # place the diagonal's bits onto their grouped axes:
+    # d has shape (2, 2^t) with bit k of the index belonging to targets[k]
+    d = diag.astype(amps.dtype).reshape((2,) + (2,) * t)  # planar, [b_{t-1},...,b_0]
+    order = [axis_of[q] for q in reversed(targets)]
+    perm = sorted(range(t), key=lambda i: order[i])
+    bshape = [1] * rank
+    for q in targets:
+        bshape[axis_of[q]] = 2
+    d = d.transpose([0] + [1 + p for p in perm]).reshape([2] + bshape)
+    fr, fi = d[0], d[1]
+    if conj:
+        fi = -fi
+
+    if controls:
+        sel = _control_selector(axis_of, controls, rank, amps.dtype)
+        fr = 1 + sel * (fr - 1)
+        fi = sel * fi
+
+    return _mul_factor(amps, shape, fr, fi)
+
+
+@partial(jax.jit, static_argnames=("n", "qubits", "controls", "conj"), donate_argnums=(0,))
+def apply_parity_phase(amps, theta, *, n: int, qubits: tuple[int, ...],
+                       controls: tuple[int, ...] = (), conj: bool = False):
+    """exp(-i theta/2 * Z x Z x ... x Z) on ``qubits`` -- multiRotateZ and its
+    controlled variant (reference mask-parity kernel ``QuEST_cpu.c:3235-3285``).
+
+    Avoids materialising the 2^t diagonal: (-1)^parity is a separable product
+    of per-axis [1,-1] vectors, so the factor is
+    cos(theta/2) - i sin(theta/2) * prod_q (-1)^{bit_q}, fully fused by XLA.
+    ``conj`` negates theta (density shadow op).
+    """
+    shape, axis_of = grouped_axes(n, tuple(qubits) + tuple(controls))
+    rank = len(shape)
+    rdtype = amps.dtype
+
+    sign = None
+    for q in qubits:
+        v = _axis_vec([1.0, -1.0], axis_of[q], rank, rdtype)
+        sign = v if sign is None else sign * v
+
+    theta = jnp.asarray(theta, dtype=rdtype)
+    if conj:
+        theta = -theta
+    fr = jnp.cos(theta / 2) * jnp.ones_like(sign)
+    fi = -jnp.sin(theta / 2) * sign
+
+    if controls:
+        sel = _control_selector(axis_of, controls, rank, rdtype)
+        fr = 1 + sel * (fr - 1)
+        fi = sel * fi
+
+    return _mul_factor(amps, shape, fr, fi)
+
+
+@partial(jax.jit, static_argnames=("conj",), donate_argnums=(0,))
+def apply_full_diagonal(amps, elems, *, conj: bool = False):
+    """Elementwise multiply by a full planar 2^n diagonal operator
+    (applyDiagonalOp; reference kernel ``QuEST_cpu.c:3975-4030``). ``elems``
+    (2, 2^n) is sharded like ``amps`` so the multiply is purely local."""
+    er, ei = elems[0].astype(amps.dtype), elems[1].astype(amps.dtype)
+    if conj:
+        ei = -ei
+    re = amps[0] * er - amps[1] * ei
+    im = amps[0] * ei + amps[1] * er
+    return jnp.stack([re, im])
+
+
+@partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
+def apply_full_diagonal_to_density(amps, elems, *, n: int):
+    """applyDiagonalOp on a density matrix: rho -> D rho (left-multiply only,
+    per the reference's densmatr_applyDiagonalOp). Row bits are the low n bits
+    of the 2n-qubit flattening, so broadcast D along the column axis."""
+    dim = 1 << n
+    t = amps.reshape(2, dim, dim)  # [plane, col, row]
+    er, ei = elems[0].astype(amps.dtype)[None, :], elems[1].astype(amps.dtype)[None, :]
+    re = t[0] * er - t[1] * ei
+    im = t[0] * ei + t[1] * er
+    return jnp.stack([re, im]).reshape(2, -1)

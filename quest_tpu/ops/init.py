@@ -1,0 +1,83 @@
+"""State initialisation kernels (reference: ``QuEST_cpu.c:1416-1680`` init
+family and the density inits in ``QuEST_cpu.c:60-135``).
+
+All states are planar float arrays of shape (2, 2^n) -- see ops.cplx. Each
+function returns a fresh array; callers shard it afterwards (or jit these
+under an output sharding so the fill happens shard-locally, which is how the
+reference's per-chunk loops behave).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def init_blank(num_amps: int, dtype):
+    """All-zero (unnormalised) state -- initBlankState."""
+    return jnp.zeros((2, num_amps), dtype=dtype)
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype", "index"))
+def init_classical(num_amps: int, dtype, index):
+    """|index> one-hot -- initClassicalState / initZeroState (index=0),
+    reference kernel statevec_initClassicalState (QuEST_cpu.c:1566+)."""
+    return jnp.zeros((2, num_amps), dtype=dtype).at[0, index].set(1)
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def init_plus(num_amps: int, dtype):
+    """Uniform superposition -- initPlusState (QuEST_cpu.c:1543+)."""
+    re = jnp.full((1, num_amps), 1.0 / math.sqrt(num_amps), dtype=dtype)
+    return jnp.concatenate([re, jnp.zeros((1, num_amps), dtype=dtype)])
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def init_debug(num_amps: int, dtype):
+    """amp_i = (2i + (2i+1) j)/10 -- initDebugState, the test fixture
+    (statevec_initDebugState, QuEST_cpu.c:1649-1680)."""
+    i = jax.lax.iota(dtype, num_amps)
+    return jnp.stack([(2 * i) / 10, (2 * i + 1) / 10])
+
+
+@partial(jax.jit, static_argnames=("n",))
+def density_from_pure(pure_amps, *, n: int):
+    """rho = |psi><psi| flattened with row bits low (initPureState; reference
+    densmatr_initPureState via pairState broadcast, QuEST_cpu_distributed.c:387-429).
+    Flat index = col * 2^n + row, element = psi_row * conj(psi_col)."""
+    pr, pi = pure_amps[0], pure_amps[1]
+    # out[c, r] = psi_r * conj(psi_c); broadcasting keeps full precision
+    # (jnp.outer lowers to a matmul, which TPU would run in bf16)
+    re = pr[:, None] * pr[None, :] + pi[:, None] * pi[None, :]
+    im = pr[:, None] * pi[None, :] - pi[:, None] * pr[None, :]
+    return jnp.stack([re, im]).reshape(2, -1)
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype", "index"))
+def density_init_classical(num_amps: int, dtype, index):
+    """rho = |s><s|: single 1 at diagonal flat index s*(2^n+1)."""
+    dim = int(math.isqrt(num_amps))
+    return jnp.zeros((2, num_amps), dtype=dtype).at[0, index * (dim + 1)].set(1)
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def density_init_plus(num_amps: int, dtype):
+    """rho = |+><+| on n qubits: every element 1/2^n."""
+    dim = int(math.isqrt(num_amps))
+    re = jnp.full((1, num_amps), 1.0 / dim, dtype=dtype)
+    return jnp.concatenate([re, jnp.zeros((1, num_amps), dtype=dtype)])
+
+
+@jax.jit
+def weighted_sum(f1, amps1, f2, amps2, fo, amps_out):
+    """out = f1*q1 + f2*q2 + fo*out with planar complex factors f = (re, im)
+    shape-(2,) arrays -- setWeightedQureg (QuEST_cpu.c:3933)."""
+    def term(f, a):
+        re = f[0] * a[0] - f[1] * a[1]
+        im = f[0] * a[1] + f[1] * a[0]
+        return jnp.stack([re, im])
+    return term(f1, amps1) + term(f2, amps2) + term(fo, amps_out)

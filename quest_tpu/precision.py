@@ -1,0 +1,68 @@
+"""Precision system for quest_tpu.
+
+The reference selects float/double/long-double at compile time
+(``QuEST/include/QuEST_precision.h:40-96``) and derives ``REAL_EPS`` from it.
+Here precision is a *runtime* choice carried per-Qureg (the dtype of its
+amplitude array), with a process-wide default selectable via the
+``QUEST_PRECISION`` environment variable (1 = single, 2 = double), mirroring
+the reference's ``-DPRECISION`` CMake cache variable.
+
+Quad precision (PRECISION=4) is impossible on TPU and is not supported; the
+validation layer rejects it explicitly.
+
+TPU notes: complex64 (f32 pairs) is the performance dtype; complex128 requires
+``jax_enable_x64`` and is primarily for correctness CI on the CPU backend.
+bfloat16 state storage is an extension beyond reference parity (not a default).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+#: map of QuEST PRECISION codes -> (real dtype, complex dtype, REAL_EPS)
+#: eps values mirror QuEST_precision.h:48,63 (1e-5 single, 1e-13 double).
+_PRECISIONS = {
+    1: ("float32", "complex64", 1e-5),
+    2: ("float64", "complex128", 1e-13),
+}
+
+
+def default_precision() -> int:
+    """Process-wide default precision code (1 or 2), from $QUEST_PRECISION."""
+    code = int(os.environ.get("QUEST_PRECISION", "1"))
+    if code not in _PRECISIONS:
+        raise ValueError(f"QUEST_PRECISION must be 1 or 2, got {code}")
+    return code
+
+
+def real_dtype(precision: int | None = None):
+    code = default_precision() if precision is None else precision
+    return jnp.dtype(_PRECISIONS[code][0])
+
+
+def complex_dtype(precision: int | None = None):
+    code = default_precision() if precision is None else precision
+    return jnp.dtype(_PRECISIONS[code][1])
+
+
+def real_eps(precision: int | None = None) -> float:
+    """Validation tolerance, as REAL_EPS in QuEST_precision.h:48,63."""
+    code = default_precision() if precision is None else precision
+    return _PRECISIONS[code][2]
+
+
+def eps_for_dtype(dtype) -> float:
+    """REAL_EPS for a given amplitude dtype."""
+    d = jnp.dtype(dtype)
+    if d in (jnp.dtype("complex64"), jnp.dtype("float32")):
+        return 1e-5
+    return 1e-13
+
+
+def precision_for_dtype(dtype) -> int:
+    d = jnp.dtype(dtype)
+    if d in (jnp.dtype("complex64"), jnp.dtype("float32")):
+        return 1
+    return 2

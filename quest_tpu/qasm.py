@@ -1,0 +1,94 @@
+"""OPENQASM 2.0 circuit recording (reference: ``QuEST/src/QuEST_qasm.c``).
+
+Pure host-side string accumulation, one logger per Qureg. The reference keeps
+a growable char buffer (1 KiB, x2 growth, QuEST_qasm.c:35-107); Python lists
+make that machinery unnecessary, but the recorded text format follows the
+reference: the OPENQASM header (``:69-77``), the gate-name table (``:40-54``),
+one-control gates as ``c<name>``, and explanatory comments for operations that
+QASM 2.0 cannot express (multi-controlled gates, decoherence, init etc. --
+the reference does the same, e.g. QuEST.c:670-674).
+"""
+
+from __future__ import annotations
+
+
+#: gate-name table, mirroring qasmGateLabels (QuEST_qasm.c:40-54)
+GATE_QASM_LABELS = {
+    "sigmaX": "x", "sigmaY": "y", "sigmaZ": "z",
+    "tGate": "t", "sGate": "s", "hadamard": "h",
+    "rotateX": "Rx", "rotateY": "Ry", "rotateZ": "Rz",
+    "unitary": "U", "phaseShift": "Rz", "swap": "swap", "sqrtSwap": "srswap",
+}
+
+
+class QASMLogger:
+    def __init__(self, num_qubits: int):
+        self.num_qubits = num_qubits
+        self.recording = False
+        self._lines: list[str] = []
+        self._write_header()
+
+    def _write_header(self):
+        self._lines = [
+            "OPENQASM 2.0;",
+            f"qreg q[{self.num_qubits}];",
+            f"creg c[{self.num_qubits}];",
+        ]
+
+    # -- control (startRecordingQASM etc., QuEST.h:3906-3965) ---------------
+
+    def start(self):
+        self.recording = True
+
+    def stop(self):
+        self.recording = False
+
+    def clear(self):
+        self._write_header()
+
+    def printed(self) -> str:
+        return "\n".join(self._lines) + "\n"
+
+    def write_to_file(self, filename: str):
+        with open(filename, "w") as f:
+            f.write(self.printed())
+
+    # -- recording ----------------------------------------------------------
+
+    def _fmt_params(self, params) -> str:
+        if not params:
+            return ""
+        return "(" + ",".join(f"{float(p):g}" for p in params) + ")"
+
+    def record_gate(self, gate: str, targets, controls=(), params=()):
+        """Record one gate application. Gates with 0 or 1 controls map to QASM
+        (``h q[0];`` / ``ch q[1],q[0];``); others become comments, as the
+        reference's qasm_recordMultiControlledGate fallback."""
+        if not self.recording:
+            return
+        label = GATE_QASM_LABELS.get(gate, gate)
+        p = self._fmt_params(params)
+        qubits = list(controls) + list(targets)
+        args = ",".join(f"q[{q}]" for q in qubits)
+        if len(controls) == 0:
+            self._lines.append(f"{label}{p} {args};")
+        elif len(controls) == 1:
+            self._lines.append(f"c{label}{p} {args};")
+        else:
+            self._lines.append(
+                f"// {len(controls)}-controlled {label}{p} on {args} "
+                "(not expressible in QASM 2.0)")
+
+    def record_measurement(self, target: int):
+        if self.recording:
+            self._lines.append(f"measure q[{target}] -> c[{target}];")
+
+    def record_init_zero(self):
+        if self.recording:
+            self._lines.append("// Initialised zero state")
+
+    def record_comment(self, comment: str):
+        """qasm_recordComment (QuEST_qasm.c): used for every op QASM cannot
+        express -- init, decoherence, phase functions, QFT internals etc."""
+        if self.recording:
+            self._lines.append(f"// {comment}")

@@ -1,0 +1,132 @@
+"""Qureg: the qubit register (reference struct at QuEST.h:360-396).
+
+The reference Qureg carries planar host arrays, an MPI receive buffer
+(``pairStateVec``), and GPU mirrors + reduction buffers. The TPU-native Qureg
+is a thin mutable handle around one device ``jax.Array`` of shape
+(2, 2^numQubitsInStateVec) -- planar (real, imag) float amplitudes, the same
+SoA layout as the reference's ComplexArray (QuEST.h:94-98), chosen because
+the TPU has no native complex dtype. It is sharded over the env's mesh (XLA
+owns all scratch/comm buffers, so pairStateVec and the reduction buffers have
+no equivalent).
+
+Mutation model: the C API mutates Quregs in place; JAX arrays are immutable.
+API functions therefore rebind ``qureg.amps`` to the new functional value --
+the handle is stable, the array is fresh (XLA donation keeps this
+allocation-neutral inside jit).
+
+Density matrices are state-vectors of 2N qubits (QuEST.c:8-10): element
+rho[row, col] lives at flat index col * 2^N + row (row bits low). Gates apply
+to row-qubit q and, conjugated, to col-qubit q+N -- the "shadow" op.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import precision, validation
+from .environment import QuESTEnv
+from .ops import init as ops_init
+from .qasm import QASMLogger
+
+
+@dataclass
+class Qureg:
+    num_qubits_represented: int
+    is_density_matrix: bool
+    amps: jax.Array
+    env: QuESTEnv
+    qasm_log: Optional[QASMLogger] = None
+
+    @property
+    def num_qubits_in_state_vec(self) -> int:
+        return (2 if self.is_density_matrix else 1) * self.num_qubits_represented
+
+    @property
+    def num_amps_total(self) -> int:
+        return 1 << self.num_qubits_in_state_vec
+
+    # parity aliases matching the reference field names
+    @property
+    def numQubitsRepresented(self) -> int:
+        return self.num_qubits_represented
+
+    @property
+    def numAmpsTotal(self) -> int:
+        return self.num_amps_total
+
+    @property
+    def dtype(self):
+        """Real dtype of the planar amplitude planes (float32/float64)."""
+        return self.amps.dtype
+
+    @property
+    def eps(self) -> float:
+        return precision.eps_for_dtype(self.amps.dtype)
+
+    def put(self, new_amps) -> None:
+        """Rebind the amplitude array, preserving the register's sharding."""
+        self.amps = new_amps
+
+    def __repr__(self):
+        kind = "density-matrix" if self.is_density_matrix else "state-vector"
+        return (f"Qureg({kind}, qubits={self.num_qubits_represented}, "
+                f"amps=2^{self.num_qubits_in_state_vec}, dtype={self.amps.dtype})")
+
+
+def _alloc(env: QuESTEnv, num_qubits_sv: int, dtype, index: int = 0) -> jax.Array:
+    num_amps = 1 << num_qubits_sv
+    amps = ops_init.init_classical(num_amps, jnp.dtype(dtype), index)
+    sharding = env.sharding(num_amps)
+    if sharding is not None:
+        amps = jax.device_put(amps, sharding)
+    return amps
+
+
+def createQureg(num_qubits: int, env: QuESTEnv, precision_code: int | None = None) -> Qureg:
+    """State-vector register in |0...0> (createQureg, QuEST.h:579)."""
+    func = "createQureg"
+    validation.validate_num_qubits(num_qubits, func)
+    dtype = precision.real_dtype(precision_code)
+    q = Qureg(num_qubits, False, _alloc(env, num_qubits, dtype), env)
+    q.qasm_log = QASMLogger(num_qubits)
+    return q
+
+
+def createDensityQureg(num_qubits: int, env: QuESTEnv, precision_code: int | None = None) -> Qureg:
+    """Density-matrix register in |0><0| (createDensityQureg, QuEST.h:673)."""
+    func = "createDensityQureg"
+    validation.validate_num_qubits(num_qubits, func)
+    validation._assert(num_qubits < 32, "Invalid number of qubits. The given number of qubits cannot be stored.", func)
+    dtype = precision.real_dtype(precision_code)
+    q = Qureg(num_qubits, True, _alloc(env, 2 * num_qubits, dtype), env)
+    q.qasm_log = QASMLogger(num_qubits)
+    return q
+
+
+def createCloneQureg(qureg: Qureg, env: QuESTEnv) -> Qureg:
+    """Deep copy (createCloneQureg, QuEST.h:694)."""
+    q = Qureg(qureg.num_qubits_represented, qureg.is_density_matrix,
+              qureg.amps + 0, env)
+    q.qasm_log = QASMLogger(qureg.num_qubits_represented)
+    return q
+
+
+def destroyQureg(qureg: Qureg, env: QuESTEnv | None = None) -> None:
+    """Release the device buffer eagerly (destroyQureg, QuEST.h:716)."""
+    try:
+        qureg.amps.delete()
+    except Exception:
+        pass
+    qureg.amps = None
+
+
+def get_np(qureg: Qureg) -> np.ndarray:
+    """Gather the full amplitude array to host as numpy complex
+    (tests / reporting)."""
+    from .ops import cplx
+    return cplx.to_complex(qureg.amps)

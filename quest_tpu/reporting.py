@@ -1,0 +1,70 @@
+"""Reporting / debug output (reference: reportState and friends,
+QuEST.h:1538-1579, QuEST_common.c:219-242) and the QASM recording API
+(QuEST.h:3906-3965)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registers import Qureg, get_np
+
+__all__ = [
+    "reportState", "reportStateToScreen", "reportQuregParams", "reportPauliHamil",
+    "startRecordingQASM", "stopRecordingQASM", "clearRecordedQASM",
+    "printRecordedQASM", "writeRecordedQASMToFile",
+]
+
+
+def reportState(qureg: Qureg) -> None:
+    """Dump amplitudes to ``state_rank_0.csv`` (reportState writes one file
+    per rank in the reference, QuEST_common.c:219-231; the single-controller
+    TPU runtime writes one)."""
+    amps = get_np(qureg)
+    with open("state_rank_0.csv", "w") as f:
+        f.write("real, imag\n")
+        for a in amps:
+            f.write(f"{a.real:.12f}, {a.imag:.12f}\n")
+
+
+def reportStateToScreen(qureg: Qureg, env=None, report_rank: int = 0) -> None:
+    amps = get_np(qureg)
+    print("Reporting state from rank 0 of 1")
+    for a in amps:
+        print(f"{a.real:.14f}, {a.imag:.14f}")
+
+
+def reportQuregParams(qureg: Qureg) -> None:
+    """(reportQuregParams, QuEST_common.c:233-242)."""
+    print("QUBITS:")
+    print(f"Number of qubits is {qureg.num_qubits_represented}.")
+    print(f"Number of amps is {qureg.num_amps_total}.")
+    print(f"Number of amps per device is "
+          f"{qureg.num_amps_total // max(1, qureg.env.num_ranks)}.")
+
+
+def reportPauliHamil(hamil) -> None:
+    """Print coeff + codes lines, matching the input file format
+    (reportPauliHamil)."""
+    for t in range(hamil.num_sum_terms):
+        codes = " ".join(str(int(c)) for c in hamil.pauli_codes[t])
+        print(f"{hamil.term_coeffs[t]:g}\t{codes}")
+
+
+def startRecordingQASM(qureg: Qureg) -> None:
+    qureg.qasm_log.start()
+
+
+def stopRecordingQASM(qureg: Qureg) -> None:
+    qureg.qasm_log.stop()
+
+
+def clearRecordedQASM(qureg: Qureg) -> None:
+    qureg.qasm_log.clear()
+
+
+def printRecordedQASM(qureg: Qureg) -> None:
+    print(qureg.qasm_log.printed(), end="")
+
+
+def writeRecordedQASMToFile(qureg: Qureg, filename: str) -> None:
+    qureg.qasm_log.write_to_file(filename)

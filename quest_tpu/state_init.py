@@ -1,0 +1,157 @@
+"""State initialisation API (reference QuEST.h:1619-1876, QuEST.c init family).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import validation as V
+from .ops import init as I
+from .registers import Qureg
+
+__all__ = [
+    "initBlankState", "initZeroState", "initPlusState", "initClassicalState",
+    "initPureState", "initDebugState", "initStateFromAmps", "setAmps",
+    "setDensityAmps", "cloneQureg", "setWeightedQureg", "getNumQubits",
+    "getNumAmps",
+]
+
+
+def _put_shaped(qureg: Qureg, amps) -> None:
+    sharding = qureg.env.sharding(qureg.num_amps_total)
+    if sharding is not None:
+        amps = jax.device_put(amps, sharding)
+    qureg.put(amps)
+
+
+def initBlankState(qureg: Qureg) -> None:
+    """All-zero amplitudes (unnormalised) (QuEST.h:1619)."""
+    _put_shaped(qureg, I.init_blank(qureg.num_amps_total, qureg.dtype))
+    if qureg.qasm_log: qureg.qasm_log.record_comment("initBlankState")
+
+
+def initZeroState(qureg: Qureg) -> None:
+    if qureg.is_density_matrix:
+        amps = I.density_init_classical(qureg.num_amps_total, qureg.dtype, 0)
+    else:
+        amps = I.init_classical(qureg.num_amps_total, qureg.dtype, 0)
+    _put_shaped(qureg, amps)
+    if qureg.qasm_log: qureg.qasm_log.record_init_zero()
+
+
+def initPlusState(qureg: Qureg) -> None:
+    if qureg.is_density_matrix:
+        amps = I.density_init_plus(qureg.num_amps_total, qureg.dtype)
+    else:
+        amps = I.init_plus(qureg.num_amps_total, qureg.dtype)
+    _put_shaped(qureg, amps)
+    if qureg.qasm_log: qureg.qasm_log.record_comment("initPlusState")
+
+
+def initClassicalState(qureg: Qureg, state_index: int) -> None:
+    func = "initClassicalState"
+    V.validate_state_index(qureg, state_index, func)
+    if qureg.is_density_matrix:
+        amps = I.density_init_classical(qureg.num_amps_total, qureg.dtype, state_index)
+    else:
+        amps = I.init_classical(qureg.num_amps_total, qureg.dtype, state_index)
+    _put_shaped(qureg, amps)
+    if qureg.qasm_log: qureg.qasm_log.record_comment(f"initClassicalState |{state_index}>")
+
+
+def initPureState(qureg: Qureg, pure: Qureg) -> None:
+    """Copy a pure state in; density targets get rho = |psi><psi|
+    (QuEST.h:1689; densmatr_initPureState)."""
+    func = "initPureState"
+    V.validate_second_qureg_state_vec(pure, func)
+    V.validate_matching_qureg_dims(qureg, pure, func)
+    if qureg.is_density_matrix:
+        amps = I.density_from_pure(pure.amps, n=qureg.num_qubits_represented)
+    else:
+        amps = pure.amps + 0
+    _put_shaped(qureg, amps)
+    if qureg.qasm_log: qureg.qasm_log.record_comment("initPureState")
+
+
+def initDebugState(qureg: Qureg) -> None:
+    """amp_i = (2i + (2i+1) i)/10: the deterministic test fixture (QuEST.h:1721)."""
+    _put_shaped(qureg, I.init_debug(qureg.num_amps_total, qureg.dtype))
+    if qureg.qasm_log: qureg.qasm_log.record_comment("initDebugState")
+
+
+def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
+    """Full overwrite from host arrays (QuEST.h:1748)."""
+    func = "initStateFromAmps"
+    reals = np.asarray(reals).reshape(-1)
+    imags = np.asarray(imags).reshape(-1)
+    V._assert(reals.size == qureg.num_amps_total and imags.size == qureg.num_amps_total,
+              "Invalid number of amplitudes. Must match the register size.", func)
+    _put_shaped(qureg, jnp.asarray(np.stack([reals, imags]), dtype=qureg.dtype))
+    if qureg.qasm_log: qureg.qasm_log.record_comment("initStateFromAmps")
+
+
+def setAmps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
+    """Overwrite a contiguous slice (QuEST.h:1797)."""
+    func = "setAmps"
+    V.validate_state_vec(qureg, func)
+    V.validate_num_amps(qureg, start_ind, num_amps, func)
+    vals = np.stack([np.asarray(reals).reshape(-1)[:num_amps],
+                     np.asarray(imags).reshape(-1)[:num_amps]])
+    qureg.put(qureg.amps.at[:, start_ind:start_ind + num_amps].set(
+        jnp.asarray(vals, dtype=qureg.dtype)))
+
+
+def setDensityAmps(qureg: Qureg, start_row: int, start_col: int, reals, imags, num_amps: int) -> None:
+    """Overwrite density elements column-wise from (start_row, start_col)
+    (QuEST.h:1829). Flat order runs down rows then across columns, matching
+    the row-bits-low layout."""
+    func = "setDensityAmps"
+    V.validate_density_matr(qureg, func)
+    dim = 1 << qureg.num_qubits_represented
+    start = start_col * dim + start_row
+    V._assert(0 <= start_row < dim and 0 <= start_col < dim,
+              "Invalid amplitude index. Note amplitudes are zero indexed.", func)
+    V._assert(num_amps >= 0 and start + num_amps <= qureg.num_amps_total,
+              "Invalid number of amplitudes. Must be >=0 and fit within the register.", func)
+    vals = np.stack([np.asarray(reals).reshape(-1)[:num_amps],
+                     np.asarray(imags).reshape(-1)[:num_amps]])
+    qureg.put(qureg.amps.at[:, start:start + num_amps].set(
+        jnp.asarray(vals, dtype=qureg.dtype)))
+
+
+def cloneQureg(target: Qureg, source: Qureg) -> None:
+    """Overwrite target's state with source's (QuEST.h:1876)."""
+    func = "cloneQureg"
+    V.validate_matching_qureg_types(target, source, func)
+    V.validate_matching_qureg_dims(target, source, func)
+    target.put(source.amps + 0)
+
+
+def setWeightedQureg(fac1: complex, qureg1: Qureg, fac2: complex, qureg2: Qureg,
+                     fac_out: complex, out: Qureg) -> None:
+    """out = fac1 q1 + fac2 q2 + facOut out (QuEST.h:5688)."""
+    func = "setWeightedQureg"
+    V.validate_matching_qureg_types(qureg1, qureg2, func)
+    V.validate_matching_qureg_types(qureg1, out, func)
+    V.validate_matching_qureg_dims(qureg1, qureg2, func)
+    V.validate_matching_qureg_dims(qureg1, out, func)
+    dt = out.dtype
+
+    def planar(f):
+        f = complex(f)
+        return jnp.asarray([f.real, f.imag], dtype=dt)
+
+    out.put(I.weighted_sum(planar(fac1), qureg1.amps,
+                           planar(fac2), qureg2.amps,
+                           planar(fac_out), out.amps))
+
+
+def getNumQubits(qureg: Qureg) -> int:
+    return qureg.num_qubits_represented
+
+
+def getNumAmps(qureg: Qureg) -> int:
+    V.validate_state_vec(qureg, "getNumAmps")
+    return qureg.num_amps_total

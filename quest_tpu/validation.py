@@ -1,0 +1,397 @@
+"""Input validation for quest_tpu.
+
+Equivalent of the reference's ``QuEST/src/QuEST_validation.c`` (1128 lines,
+83 ``validate*`` functions): every public API function validates its inputs
+*first*, and reports failures through a single overridable hook.
+
+The reference's hook is the C function ``invalidQuESTInputError`` (declared
+user-overridable at ``QuEST/include/QuEST.h:6160-6188``; default prints and
+exits). Here the hook is a module-level callable ``invalid_quest_input_error``
+that by default raises :class:`QuESTError`; tests and embedders may replace it
+with :func:`set_input_error_handler` (the reference's test suite does exactly
+this trick — ``tests/main.cpp:27-29`` redefines it to throw).
+
+Error messages follow the reference's phrasing closely (``errorMessages`` table
+in QuEST_validation.c) so that message-matching tests carry over.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+class QuESTError(Exception):
+    """Raised (by the default hook) when API input validation fails."""
+
+    def __init__(self, message: str, func: str = ""):
+        self.message = message
+        self.func = func
+        super().__init__(message if not func else f"{func}: {message}")
+
+
+def _default_handler(err_msg: str, err_func: str) -> None:
+    raise QuESTError(err_msg, err_func)
+
+
+#: the overridable hook, mirroring invalidQuESTInputError (QuEST.h:6160-6188)
+invalid_quest_input_error: Callable[[str, str], None] = _default_handler
+
+
+def set_input_error_handler(handler: Callable[[str, str], None] | None) -> None:
+    """Override the validation failure hook (None restores the default)."""
+    global invalid_quest_input_error
+    invalid_quest_input_error = handler if handler is not None else _default_handler
+
+
+def _fail(msg: str, func: str) -> None:
+    invalid_quest_input_error(msg, func)
+    # If a user hook returns instead of raising, we still must not continue
+    # with invalid inputs (the reference documents returning as UB); raise.
+    raise QuESTError(msg, func)
+
+
+def _assert(cond: bool, msg: str, func: str) -> None:
+    if not cond:
+        _fail(msg, func)
+
+
+# ---------------------------------------------------------------------------
+# qubit / register validation (QuEST_validation.c:379-520)
+# ---------------------------------------------------------------------------
+
+def validate_num_qubits(num_qubits: int, func: str) -> None:
+    _assert(num_qubits > 0, "Invalid number of qubits. Must create >0.", func)
+    # mirror validateNumQubitsInQureg's overflow guard (QuEST_validation.c:368-377)
+    _assert(num_qubits < 63, "Invalid number of qubits. The given number of qubits cannot be stored.", func)
+
+
+def validate_target(qureg, target: int, func: str) -> None:
+    _assert(
+        0 <= target < qureg.num_qubits_represented,
+        "Invalid target qubit. Note qubits are zero indexed.",
+        func,
+    )
+
+
+def validate_control(qureg, control: int, func: str) -> None:
+    _assert(
+        0 <= control < qureg.num_qubits_represented,
+        "Invalid control qubit. Note qubits are zero indexed.",
+        func,
+    )
+
+
+def validate_control_target(qureg, control: int, target: int, func: str) -> None:
+    validate_target(qureg, target, func)
+    validate_control(qureg, control, func)
+    _assert(control != target, "Control qubit cannot equal target qubit.", func)
+
+
+def validate_unique_targets(qureg, q1: int, q2: int, func: str) -> None:
+    validate_target(qureg, q1, func)
+    validate_target(qureg, q2, func)
+    _assert(q1 != q2, "Qubits must be unique.", func)
+
+
+def validate_multi_targets(qureg, targets: Sequence[int], func: str) -> None:
+    _assert(
+        0 < len(targets) <= qureg.num_qubits_represented,
+        "Invalid number of target qubits.",
+        func,
+    )
+    for t in targets:
+        validate_target(qureg, t, func)
+    _assert(len(set(targets)) == len(targets), "The target qubits must be unique.", func)
+
+
+def validate_multi_controls(qureg, controls: Sequence[int], func: str) -> None:
+    _assert(
+        0 <= len(controls) < qureg.num_qubits_represented,
+        "Invalid number of control qubits.",
+        func,
+    )
+    for c in controls:
+        validate_control(qureg, c, func)
+    _assert(len(set(controls)) == len(controls), "The control qubits must be unique.", func)
+
+
+def validate_multi_controls_multi_targets(qureg, controls, targets, func: str) -> None:
+    validate_multi_controls(qureg, controls, func)
+    validate_multi_targets(qureg, targets, func)
+    _assert(
+        not (set(controls) & set(targets)),
+        "Control and target qubits must be disjoint.",
+        func,
+    )
+
+
+def validate_control_state(control_state: Sequence[int], num_controls: int, func: str) -> None:
+    _assert(
+        len(control_state) == num_controls and all(s in (0, 1) for s in control_state),
+        "Invalid control-state. Each qubit state must be 0 or 1.",
+        func,
+    )
+
+
+def validate_outcome(outcome: int, func: str) -> None:
+    _assert(outcome in (0, 1), "Invalid measurement outcome -- must be either 0 or 1.", func)
+
+
+# ---------------------------------------------------------------------------
+# matrix validation (QuEST_validation.c:522-660)
+# ---------------------------------------------------------------------------
+
+def _as_matrix(m) -> np.ndarray:
+    return np.asarray(m)
+
+
+def validate_matrix_size(matrix, num_targets: int, func: str) -> None:
+    m = _as_matrix(matrix)
+    dim = 2 ** num_targets
+    _assert(
+        m.ndim == 2 and m.shape == (dim, dim),
+        "Matrix size does not match the number of target qubits.",
+        func,
+    )
+
+
+def is_unitary(matrix, eps: float) -> bool:
+    m = _as_matrix(matrix)
+    ident = np.eye(m.shape[0])
+    return bool(np.allclose(m @ m.conj().T, ident, atol=eps * m.shape[0]))
+
+
+def validate_unitary_matrix(matrix, num_targets: int, eps: float, func: str) -> None:
+    validate_matrix_size(matrix, num_targets, func)
+    _assert(is_unitary(matrix, eps), "Matrix is not unitary.", func)
+
+
+def validate_unitary_complex_pair(alpha: complex, beta: complex, eps: float, func: str) -> None:
+    _assert(
+        abs(abs(alpha) ** 2 + abs(beta) ** 2 - 1) < eps,
+        "Compact unitary formed by complex alpha and beta is not unitary.",
+        func,
+    )
+
+
+def validate_vector(v, func: str) -> None:
+    _assert(
+        math.sqrt(v[0] ** 2 + v[1] ** 2 + v[2] ** 2) > 1e-15,
+        "Invalid axis vector. Must be non-zero.",
+        func,
+    )
+
+
+def validate_kraus_ops(ops, num_targets: int, eps: float, func: str, check_cptp: bool = True) -> None:
+    dim = 2 ** num_targets
+    _assert(len(ops) > 0, "Invalid number of operators.", func)
+    _assert(
+        len(ops) <= dim * dim,
+        "Invalid number of operators. Must be >0 and <= 4^numTargets.",
+        func,
+    )
+    for op in ops:
+        validate_matrix_size(op, num_targets, func)
+    if check_cptp:
+        acc = np.zeros((dim, dim), dtype=np.complex128)
+        for op in ops:
+            m = _as_matrix(op).astype(np.complex128)
+            acc += m.conj().T @ m
+        _assert(
+            np.allclose(acc, np.eye(dim), atol=eps * dim),
+            "The specified Kraus map is not completely positive and trace preserving (CPTP).",
+            func,
+        )
+
+
+def validate_probability(prob: float, max_prob: float, func: str) -> None:
+    _assert(0 <= prob <= max_prob + 1e-30, "Probabilities must be in [0, 1].", func)
+
+
+def validate_one_qubit_dephase_prob(prob: float, func: str) -> None:
+    _assert(0 <= prob <= 1 / 2, "The probability of a single-qubit dephase error cannot exceed 1/2.", func)
+
+
+def validate_two_qubit_dephase_prob(prob: float, func: str) -> None:
+    _assert(0 <= prob <= 3 / 4, "The probability of a two-qubit dephase error cannot exceed 3/4.", func)
+
+
+def validate_one_qubit_depol_prob(prob: float, func: str) -> None:
+    _assert(0 <= prob <= 3 / 4, "The probability of a single-qubit depolarising error cannot exceed 3/4.", func)
+
+
+def validate_two_qubit_depol_prob(prob: float, func: str) -> None:
+    _assert(0 <= prob <= 15 / 16, "The probability of a two-qubit depolarising error cannot exceed 15/16.", func)
+
+
+def validate_one_qubit_damping_prob(prob: float, func: str) -> None:
+    _assert(0 <= prob <= 1, "The probability of a single-qubit damping error cannot exceed 1.", func)
+
+
+def validate_pauli_probs(px: float, py: float, pz: float, func: str) -> None:
+    for p in (px, py, pz):
+        _assert(p >= 0, "Probabilities must be in [0, 1].", func)
+    # mirror validateOneQubitPauliProbs: each prob may not exceed its marginal limit
+    _assert(
+        px + py + pz <= 1,
+        "The probabilities of any of the single-qubit Pauli errors cannot exceed the probability of no error.",
+        func,
+    )
+
+
+# ---------------------------------------------------------------------------
+# register-kind validation
+# ---------------------------------------------------------------------------
+
+def validate_density_matr(qureg, func: str) -> None:
+    _assert(qureg.is_density_matrix, "Operation valid only for density matrices.", func)
+
+
+def validate_state_vec(qureg, func: str) -> None:
+    _assert(not qureg.is_density_matrix, "Operation valid only for state-vectors.", func)
+
+
+def validate_matching_qureg_dims(a, b, func: str) -> None:
+    _assert(
+        a.num_qubits_represented == b.num_qubits_represented,
+        "Dimensions of the qubit registers don't match.",
+        func,
+    )
+
+
+def validate_matching_qureg_types(a, b, func: str) -> None:
+    _assert(
+        a.is_density_matrix == b.is_density_matrix,
+        "Registers must both be state-vectors or both be density matrices.",
+        func,
+    )
+
+
+def validate_second_qureg_state_vec(qureg2, func: str) -> None:
+    _assert(not qureg2.is_density_matrix, "Second argument must be a state-vector.", func)
+
+
+# ---------------------------------------------------------------------------
+# amplitude-indexing / misc validation
+# ---------------------------------------------------------------------------
+
+def validate_amp_index(qureg, index: int, func: str) -> None:
+    _assert(
+        0 <= index < qureg.num_amps_total,
+        "Invalid amplitude index. Note amplitudes are zero indexed.",
+        func,
+    )
+
+
+def validate_num_amps(qureg, start: int, num: int, func: str) -> None:
+    validate_amp_index(qureg, start, func)
+    _assert(
+        num >= 0 and start + num <= qureg.num_amps_total,
+        "Invalid number of amplitudes. Must be >=0 and fit within the register.",
+        func,
+    )
+
+
+def validate_state_index(qureg, state_index: int, func: str) -> None:
+    _assert(
+        0 <= state_index < 2 ** qureg.num_qubits_represented,
+        "Invalid state index. Note states are zero indexed.",
+        func,
+    )
+
+
+def validate_num_ranks(num_ranks: int, func: str) -> None:
+    # power-of-2 device count, as validateNumRanks (QuEST_validation.c:354-366)
+    _assert(
+        num_ranks >= 1 and (num_ranks & (num_ranks - 1)) == 0,
+        "Invalid number of devices. Must be a power of 2.",
+        func,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pauli / Hamiltonian validation
+# ---------------------------------------------------------------------------
+
+def validate_pauli_codes(codes, func: str) -> None:
+    for c in codes:
+        _assert(
+            int(c) in (0, 1, 2, 3),
+            "Invalid Pauli code. Codes must be 0 (or PAULI_I), 1 (PAULI_X), 2 (PAULI_Y) or 3 (PAULI_Z).",
+            func,
+        )
+
+
+def validate_pauli_hamil(hamil, func: str) -> None:
+    _assert(
+        hamil.num_qubits > 0 and hamil.num_sum_terms > 0,
+        "Invalid PauliHamil parameters. The number of qubits and terms must be strictly positive.",
+        func,
+    )
+    validate_pauli_codes(hamil.pauli_codes.ravel(), func)
+
+
+def validate_hamil_matches_qureg(qureg, hamil, func: str) -> None:
+    _assert(
+        hamil.num_qubits == qureg.num_qubits_represented,
+        "The PauliHamil must act on the same number of qubits as the register.",
+        func,
+    )
+
+
+def validate_trotter_params(order: int, reps: int, func: str) -> None:
+    _assert(
+        order > 0 and (order == 1 or order % 2 == 0),
+        "Invalid Trotter-Suzuki order. Must be 1, or an even number.",
+        func,
+    )
+    _assert(reps > 0, "Invalid number of Trotter repetitions. Must be >=1.", func)
+
+
+def validate_diag_op_matches_qureg(qureg, op, func: str) -> None:
+    _assert(
+        op.num_qubits == qureg.num_qubits_represented,
+        "The DiagonalOp must act on the same number of qubits as the register.",
+        func,
+    )
+
+
+def validate_num_elems(op, start: int, num: int, func: str) -> None:
+    total = 2 ** op.num_qubits
+    _assert(0 <= start < total, "Invalid element index.", func)
+    _assert(num >= 0 and start + num <= total, "Invalid number of elements.", func)
+
+
+def validate_phase_func_overrides(reg_sizes, encoding, override_inds, num_overrides,
+                                  func: str) -> None:
+    """Override indices are stored flat, one per register per override
+    (QuEST_cpu.c:4330-4341); each must be representable by its register."""
+    n_regs = len(reg_sizes)
+    _assert(len(override_inds) == num_overrides * n_regs,
+            "Invalid number of override indices.", func)
+    for r, m in enumerate(reg_sizes):
+        lo, hi = encoded_range(m, encoding)
+        for i in range(num_overrides):
+            _assert(lo <= int(override_inds[i * n_regs + r]) <= hi,
+                    "Invalid phase function override index, not representable by the qubit sub-register.",
+                    func)
+
+
+def validate_num_pauli_codes(codes, expected: int, func: str) -> None:
+    _assert(len(codes) == expected,
+            "Invalid number of Pauli codes. The number of codes must match the number of target qubits.",
+            func)
+    validate_pauli_codes(codes, func)
+
+
+def encoded_range(num_qubits: int, encoding) -> tuple[int, int]:
+    """Representable value range of a sub-register under an encoding.
+
+    encoding 0 = UNSIGNED, 1 = TWOS_COMPLEMENT (as enum bitEncoding).
+    """
+    if int(encoding) == 0:
+        return 0, 2 ** num_qubits - 1
+    return -(2 ** (num_qubits - 1)), 2 ** (num_qubits - 1) - 1

@@ -1,0 +1,64 @@
+"""Shared test utilities: state injection/extraction and comparison.
+
+Mirrors the reference's toQVector/toQMatrix + areEqual machinery
+(tests/utilities.cpp:965-1259) in numpy terms.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import quest_tpu as qt
+
+#: default register size, as the reference's NUM_QUBITS (tests/utilities.hpp:37)
+NUM_QUBITS = 5
+
+#: comparison tolerance; reference uses REAL_EPS-scaled margins
+TOL = 1e-10
+
+
+def get_statevec(qureg) -> np.ndarray:
+    return qt.get_np(qureg)
+
+
+def get_density(qureg) -> np.ndarray:
+    """rho as a (2^n, 2^n) matrix; flat layout is [col, row] so transpose."""
+    n = qureg.num_qubits_represented
+    return qt.get_np(qureg).reshape(1 << n, 1 << n).T
+
+
+def set_statevec(qureg, vec: np.ndarray) -> None:
+    qt.initStateFromAmps(qureg, np.real(vec), np.imag(vec))
+
+
+def set_density(qureg, rho: np.ndarray) -> None:
+    flat = rho.T.reshape(-1)  # [col, row] flattening
+    import jax.numpy as jnp
+    qureg.put(jnp.asarray(np.stack([flat.real, flat.imag]), dtype=qureg.dtype))
+
+
+def assert_statevec_equal(qureg, ref: np.ndarray, tol: float = TOL):
+    got = get_statevec(qureg)
+    assert np.allclose(got, ref, atol=tol), (
+        f"statevector mismatch: max|diff|={np.abs(got - ref).max():.3e}")
+
+
+def assert_density_equal(qureg, ref: np.ndarray, tol: float = TOL):
+    got = get_density(qureg)
+    assert np.allclose(got, ref, atol=tol), (
+        f"density mismatch: max|diff|={np.abs(got - ref).max():.3e}")
+
+
+def debug_state_and_ref(qureg):
+    """initDebugState the register and return the matching reference state
+    (vector, or [col,row]->matrix for densities). Guards against the
+    all-zero-agreement trap like assertQuregAndRefInDebugState
+    (tests/utilities.hpp:79-97)."""
+    from . import oracle
+    qt.initDebugState(qureg)
+    amps = oracle.debug_statevec(qureg.num_amps_total)
+    assert abs(amps[1] - (0.2 + 0.3j)) < 1e-12
+    if qureg.is_density_matrix:
+        n = qureg.num_qubits_represented
+        return amps.reshape(1 << n, 1 << n).T
+    return amps

@@ -1,0 +1,258 @@
+"""Calculation correctness (reference: tests/test_calculations.cpp, 19 cases)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+from . import oracle
+from .helpers import NUM_QUBITS, set_density, set_statevec
+
+ENV = qt.createQuESTEnv()
+RNG = np.random.RandomState(99)
+DIM = 1 << NUM_QUBITS
+
+
+def make_statevec():
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    v = oracle.random_statevec(NUM_QUBITS, RNG)
+    set_statevec(q, v)
+    return q, v
+
+
+def make_density():
+    q = qt.createDensityQureg(NUM_QUBITS, ENV)
+    rho = oracle.random_density(NUM_QUBITS, RNG)
+    set_density(q, rho)
+    return q, rho
+
+
+def test_calcTotalProb_statevec():
+    q, v = make_statevec()
+    assert qt.calcTotalProb(q) == pytest.approx(1.0)
+    qt.destroyQureg(q, ENV)
+
+
+def test_calcTotalProb_density():
+    q, rho = make_density()
+    assert qt.calcTotalProb(q) == pytest.approx(np.trace(rho).real)
+    qt.destroyQureg(q, ENV)
+
+
+@pytest.mark.parametrize("target", range(NUM_QUBITS))
+@pytest.mark.parametrize("outcome", [0, 1])
+def test_calcProbOfOutcome_statevec(target, outcome):
+    q, v = make_statevec()
+    probs = np.abs(v) ** 2
+    mask = ((np.arange(DIM) >> target) & 1) == outcome
+    assert qt.calcProbOfOutcome(q, target, outcome) == pytest.approx(probs[mask].sum())
+    qt.destroyQureg(q, ENV)
+
+
+@pytest.mark.parametrize("target", range(NUM_QUBITS))
+def test_calcProbOfOutcome_density(target):
+    q, rho = make_density()
+    diag = np.real(np.diagonal(rho))
+    mask = ((np.arange(DIM) >> target) & 1) == 1
+    assert qt.calcProbOfOutcome(q, target, 1) == pytest.approx(diag[mask].sum())
+    qt.destroyQureg(q, ENV)
+
+
+@pytest.mark.parametrize("targets", [(0,), (1, 3), (4, 0, 2)])
+def test_calcProbOfAllOutcomes_statevec(targets):
+    q, v = make_statevec()
+    probs = np.abs(v) ** 2
+    got = qt.calcProbOfAllOutcomes(q, targets)
+    ref = np.zeros(1 << len(targets))
+    for i in range(DIM):
+        o = sum(((i >> t) & 1) << k for k, t in enumerate(targets))
+        ref[o] += probs[i]
+    assert np.allclose(got, ref)
+    qt.destroyQureg(q, ENV)
+
+
+@pytest.mark.parametrize("targets", [(2,), (0, 4)])
+def test_calcProbOfAllOutcomes_density(targets):
+    q, rho = make_density()
+    diag = np.real(np.diagonal(rho))
+    got = qt.calcProbOfAllOutcomes(q, targets)
+    ref = np.zeros(1 << len(targets))
+    for i in range(DIM):
+        o = sum(((i >> t) & 1) << k for k, t in enumerate(targets))
+        ref[o] += diag[i]
+    assert np.allclose(got, ref)
+    qt.destroyQureg(q, ENV)
+
+
+def test_calcInnerProduct():
+    q1, v1 = make_statevec()
+    q2, v2 = make_statevec()
+    assert qt.calcInnerProduct(q1, q2) == pytest.approx(np.vdot(v1, v2))
+    qt.destroyQureg(q1, ENV)
+    qt.destroyQureg(q2, ENV)
+
+
+def test_calcDensityInnerProduct():
+    q1, r1 = make_density()
+    q2, r2 = make_density()
+    ref = np.real(np.trace(r1.conj().T @ r2))
+    assert qt.calcDensityInnerProduct(q1, q2) == pytest.approx(ref)
+    qt.destroyQureg(q1, ENV)
+    qt.destroyQureg(q2, ENV)
+
+
+def test_calcPurity():
+    q, rho = make_density()
+    assert qt.calcPurity(q) == pytest.approx(np.real(np.trace(rho @ rho)))
+    qt.destroyQureg(q, ENV)
+
+
+def test_calcFidelity_statevec():
+    q1, v1 = make_statevec()
+    q2, v2 = make_statevec()
+    assert qt.calcFidelity(q1, q2) == pytest.approx(abs(np.vdot(v1, v2)) ** 2)
+    qt.destroyQureg(q1, ENV)
+    qt.destroyQureg(q2, ENV)
+
+
+def test_calcFidelity_density():
+    q, rho = make_density()
+    p, v = make_statevec()
+    ref = np.real(np.vdot(v, rho @ v))
+    assert qt.calcFidelity(q, p) == pytest.approx(ref)
+    qt.destroyQureg(q, ENV)
+    qt.destroyQureg(p, ENV)
+
+
+def test_calcHilbertSchmidtDistance():
+    q1, r1 = make_density()
+    q2, r2 = make_density()
+    ref = np.sqrt(np.sum(np.abs(r1 - r2) ** 2))
+    assert qt.calcHilbertSchmidtDistance(q1, q2) == pytest.approx(ref)
+    qt.destroyQureg(q1, ENV)
+    qt.destroyQureg(q2, ENV)
+
+
+@pytest.mark.parametrize("targets,codes", [
+    ((0,), (3,)), ((1,), (1,)), ((2,), (2,)), ((0, 3), (1, 3)), ((4, 1), (2, 1)),
+])
+def test_calcExpecPauliProd_statevec(targets, codes):
+    q, v = make_statevec()
+    work = qt.createQureg(NUM_QUBITS, ENV)
+    P = oracle.pauli_product_matrix(NUM_QUBITS, targets, codes)
+    ref = np.real(np.vdot(v, P @ v))
+    assert qt.calcExpecPauliProd(q, targets, codes, work) == pytest.approx(ref)
+    qt.destroyQureg(q, ENV)
+    qt.destroyQureg(work, ENV)
+
+
+@pytest.mark.parametrize("targets,codes", [((0,), (3,)), ((2, 4), (1, 2))])
+def test_calcExpecPauliProd_density(targets, codes):
+    q, rho = make_density()
+    work = qt.createDensityQureg(NUM_QUBITS, ENV)
+    P = oracle.pauli_product_matrix(NUM_QUBITS, targets, codes)
+    ref = np.real(np.trace(P @ rho))
+    assert qt.calcExpecPauliProd(q, targets, codes, work) == pytest.approx(ref)
+    qt.destroyQureg(q, ENV)
+    qt.destroyQureg(work, ENV)
+
+
+def test_calcExpecPauliSum_statevec():
+    q, v = make_statevec()
+    work = qt.createQureg(NUM_QUBITS, ENV)
+    codes = [[1, 0, 0, 3, 0], [0, 2, 2, 0, 0], [3, 3, 3, 3, 3]]
+    coeffs = [0.3, -1.1, 0.7]
+    ref = 0.0
+    for c, row in zip(coeffs, codes):
+        P = oracle.pauli_product_matrix(NUM_QUBITS, range(NUM_QUBITS), row)
+        ref += c * np.real(np.vdot(v, P @ v))
+    assert qt.calcExpecPauliSum(q, codes, coeffs, work) == pytest.approx(ref)
+    qt.destroyQureg(q, ENV)
+    qt.destroyQureg(work, ENV)
+
+
+def test_calcExpecPauliHamil():
+    q, v = make_statevec()
+    work = qt.createQureg(NUM_QUBITS, ENV)
+    hamil = qt.createPauliHamil(NUM_QUBITS, 2)
+    qt.initPauliHamil(hamil, [0.5, -0.9], [[1, 1, 0, 0, 0], [0, 0, 3, 0, 2]])
+    ref = 0.0
+    for c, row in zip(hamil.term_coeffs, hamil.pauli_codes):
+        P = oracle.pauli_product_matrix(NUM_QUBITS, range(NUM_QUBITS), row)
+        ref += c * np.real(np.vdot(v, P @ v))
+    assert qt.calcExpecPauliHamil(q, hamil, work) == pytest.approx(ref)
+    qt.destroyQureg(q, ENV)
+    qt.destroyQureg(work, ENV)
+
+
+def test_calcExpecDiagonalOp():
+    q, v = make_statevec()
+    op = qt.createDiagonalOp(NUM_QUBITS, ENV)
+    re, im = RNG.randn(DIM), RNG.randn(DIM)
+    qt.initDiagonalOp(op, re, im)
+    ref = np.sum(np.abs(v) ** 2 * (re + 1j * im))
+    assert qt.calcExpecDiagonalOp(q, op) == pytest.approx(ref)
+    qt.destroyQureg(q, ENV)
+
+
+def test_validation_mismatched():
+    q1 = qt.createQureg(NUM_QUBITS, ENV)
+    q2 = qt.createQureg(NUM_QUBITS - 1, ENV)
+    with pytest.raises(qt.QuESTError, match="[Dd]imensions"):
+        qt.calcInnerProduct(q1, q2)
+    rho = qt.createDensityQureg(NUM_QUBITS, ENV)
+    with pytest.raises(qt.QuESTError, match="state-vector"):
+        qt.calcInnerProduct(q1, rho)
+    with pytest.raises(qt.QuESTError, match="density"):
+        qt.calcPurity(q1)
+    qt.destroyQureg(q1, ENV)
+    qt.destroyQureg(q2, ENV)
+    qt.destroyQureg(rho, ENV)
+
+
+# measurement semantics
+
+def test_measure_collapse():
+    q = qt.createQureg(2, ENV)
+    qt.seedQuEST(ENV, [42])
+    qt.hadamard(q, 0)
+    outcome, prob = qt.measureWithStats(q, 0)
+    assert outcome in (0, 1)
+    assert prob == pytest.approx(0.5)
+    assert qt.calcProbOfOutcome(q, 0, outcome) == pytest.approx(1.0)
+    qt.destroyQureg(q, ENV)
+
+
+def test_measure_deterministic_seeding():
+    outcomes1, outcomes2 = [], []
+    for outcomes in (outcomes1, outcomes2):
+        qt.seedQuEST(ENV, [7, 13])
+        for _ in range(10):
+            q = qt.createQureg(1, ENV)
+            qt.hadamard(q, 0)
+            outcomes.append(qt.measure(q, 0))
+            qt.destroyQureg(q, ENV)
+    assert outcomes1 == outcomes2
+    assert 0 < sum(outcomes1) < 10  # both outcomes occur with seed [7,13]
+
+
+def test_collapseToOutcome():
+    q = qt.createQureg(2, ENV)
+    qt.hadamard(q, 0)
+    qt.hadamard(q, 1)
+    p = qt.collapseToOutcome(q, 1, 1)
+    assert p == pytest.approx(0.5)
+    assert qt.calcProbOfOutcome(q, 1, 1) == pytest.approx(1.0)
+    with pytest.raises(qt.QuESTError, match="zero probability"):
+        qt.collapseToOutcome(q, 1, 0)
+    qt.destroyQureg(q, ENV)
+
+
+def test_collapse_density():
+    q = qt.createDensityQureg(2, ENV)
+    qt.initPlusState(q)
+    p = qt.collapseToOutcome(q, 0, 1)
+    assert p == pytest.approx(0.5)
+    assert qt.calcProbOfOutcome(q, 0, 1) == pytest.approx(1.0)
+    assert qt.calcTotalProb(q) == pytest.approx(1.0)
+    qt.destroyQureg(q, ENV)

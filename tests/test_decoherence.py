@@ -1,0 +1,174 @@
+"""Decoherence channel correctness (reference: tests/test_decoherence.cpp,
+13 cases). Channels are checked against explicit Kraus sums on dense matrices."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+from . import oracle
+from .helpers import assert_density_equal, set_density
+
+N = 4  # density tests use 4 qubits to stay fast (16x16 matrices)
+ENV = qt.createQuESTEnv()
+RNG = np.random.RandomState(55)
+
+I2 = np.eye(2, dtype=complex)
+X = oracle.pauli_matrix(1)
+Y = oracle.pauli_matrix(2)
+Z = oracle.pauli_matrix(3)
+
+
+@pytest.fixture
+def rho_pair():
+    q = qt.createDensityQureg(N, ENV)
+    rho = oracle.random_density(N, RNG)
+    set_density(q, rho)
+    yield q, rho
+    qt.destroyQureg(q, ENV)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mixDephasing(rho_pair, target):
+    q, rho = rho_pair
+    p = 0.21
+    qt.mixDephasing(q, target, p)
+    ref = oracle.apply_kraus_to_density(
+        rho, N, (target,), [np.sqrt(1 - p) * I2, np.sqrt(p) * Z])
+    assert_density_equal(q, ref)
+
+
+@pytest.mark.parametrize("q1,q2", [(0, 1), (2, 0), (3, 1)])
+def test_mixTwoQubitDephasing(rho_pair, q1, q2):
+    q, rho = rho_pair
+    p = 0.3
+    qt.mixTwoQubitDephasing(q, q1, q2, p)
+    z1 = oracle.full_operator(N, (q1,), Z)
+    z2 = oracle.full_operator(N, (q2,), Z)
+    ref = ((1 - p) * rho
+           + p / 3 * (z1 @ rho @ z1 + z2 @ rho @ z2 + z1 @ z2 @ rho @ z2 @ z1))
+    assert_density_equal(q, ref)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mixDepolarising(rho_pair, target):
+    q, rho = rho_pair
+    p = 0.4
+    qt.mixDepolarising(q, target, p)
+    ops = [np.sqrt(1 - p) * I2, np.sqrt(p / 3) * X, np.sqrt(p / 3) * Y,
+           np.sqrt(p / 3) * Z]
+    assert_density_equal(q, oracle.apply_kraus_to_density(rho, N, (target,), ops))
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mixDamping(rho_pair, target):
+    q, rho = rho_pair
+    p = 0.35
+    qt.mixDamping(q, target, p)
+    k0 = np.array([[1, 0], [0, np.sqrt(1 - p)]], dtype=complex)
+    k1 = np.array([[0, np.sqrt(p)], [0, 0]], dtype=complex)
+    assert_density_equal(q, oracle.apply_kraus_to_density(rho, N, (target,), [k0, k1]))
+
+
+@pytest.mark.parametrize("q1,q2", [(0, 1), (3, 2)])
+def test_mixTwoQubitDepolarising(rho_pair, q1, q2):
+    q, rho = rho_pair
+    p = 0.5
+    qt.mixTwoQubitDepolarising(q, q1, q2, p)
+    ref = (1 - p) * rho
+    for a in range(4):
+        for b in range(4):
+            if a == 0 and b == 0:
+                continue
+            # a acts on q1, b on q2
+            m = np.kron(oracle.pauli_matrix(b), oracle.pauli_matrix(a))
+            F = oracle.full_operator(N, (q1, q2), m)
+            ref += p / 15 * (F @ rho @ F.conj().T)
+    assert_density_equal(q, ref)
+
+
+def test_mixPauli(rho_pair):
+    q, rho = rho_pair
+    px, py, pz = 0.1, 0.15, 0.2
+    target = 2
+    qt.mixPauli(q, target, px, py, pz)
+    ops = [np.sqrt(1 - px - py - pz) * I2, np.sqrt(px) * X,
+           np.sqrt(py) * Y, np.sqrt(pz) * Z]
+    assert_density_equal(q, oracle.apply_kraus_to_density(rho, N, (target,), ops))
+
+
+def test_mixDensityMatrix(rho_pair):
+    q, rho = rho_pair
+    other = qt.createDensityQureg(N, ENV)
+    rho2 = oracle.random_density(N, RNG)
+    set_density(other, rho2)
+    p = 0.42
+    qt.mixDensityMatrix(q, p, other)
+    assert_density_equal(q, (1 - p) * rho + p * rho2)
+    qt.destroyQureg(other, ENV)
+
+
+@pytest.mark.parametrize("target", range(N))
+@pytest.mark.parametrize("num_ops", [1, 2, 4])
+def test_mixKrausMap(rho_pair, target, num_ops):
+    q, rho = rho_pair
+    ops = oracle.random_kraus(1, num_ops, RNG)
+    qt.mixKrausMap(q, target, ops)
+    assert_density_equal(q, oracle.apply_kraus_to_density(rho, N, (target,), ops))
+
+
+@pytest.mark.parametrize("q1,q2", [(0, 1), (1, 0), (3, 1), (2, 3)])
+def test_mixTwoQubitKrausMap(rho_pair, q1, q2):
+    q, rho = rho_pair
+    ops = oracle.random_kraus(2, 3, RNG)
+    qt.mixTwoQubitKrausMap(q, q1, q2, ops)
+    assert_density_equal(q, oracle.apply_kraus_to_density(rho, N, (q1, q2), ops))
+
+
+@pytest.mark.parametrize("targets", [(0,), (1, 3), (2, 0, 3)])
+def test_mixMultiQubitKrausMap(rho_pair, targets):
+    q, rho = rho_pair
+    ops = oracle.random_kraus(len(targets), 2, RNG)
+    qt.mixMultiQubitKrausMap(q, targets, ops)
+    assert_density_equal(q, oracle.apply_kraus_to_density(rho, N, targets, ops))
+
+
+def test_mixNonTPKrausMap(rho_pair):
+    q, rho = rho_pair
+    ops = [np.array([[0.5, 0.2], [0.0, 0.3j]])]  # deliberately non-CPTP
+    qt.mixNonTPKrausMap(q, 1, ops)
+    assert_density_equal(q, oracle.apply_kraus_to_density(rho, N, (1,), ops))
+
+
+def test_mixNonTPMultiQubitKrausMap(rho_pair):
+    q, rho = rho_pair
+    ops = [RNG.randn(4, 4) + 1j * RNG.randn(4, 4)]
+    qt.mixNonTPMultiQubitKrausMap(q, (0, 2), ops)
+    assert_density_equal(q, oracle.apply_kraus_to_density(rho, N, (0, 2), ops))
+
+
+# validation
+
+def test_validation_probabilities(rho_pair):
+    q, _ = rho_pair
+    with pytest.raises(qt.QuESTError, match="cannot exceed 1/2"):
+        qt.mixDephasing(q, 0, 0.6)
+    with pytest.raises(qt.QuESTError, match="cannot exceed 3/4"):
+        qt.mixDepolarising(q, 0, 0.8)
+    with pytest.raises(qt.QuESTError):
+        qt.mixDamping(q, 0, 1.2)
+    with pytest.raises(qt.QuESTError):
+        qt.mixPauli(q, 0, 0.6, 0.3, 0.3)
+
+
+def test_validation_statevec_rejected():
+    sv = qt.createQureg(N, ENV)
+    with pytest.raises(qt.QuESTError, match="density"):
+        qt.mixDephasing(sv, 0, 0.1)
+    qt.destroyQureg(sv, ENV)
+
+
+def test_validation_non_cptp(rho_pair):
+    q, _ = rho_pair
+    with pytest.raises(qt.QuESTError, match="CPTP"):
+        qt.mixKrausMap(q, 0, [np.eye(2) * 0.5])

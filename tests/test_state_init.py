@@ -1,0 +1,161 @@
+"""State initialisation correctness (reference: tests/test_state_initialisations.cpp,
+11 cases)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+from . import oracle
+from .helpers import (NUM_QUBITS, assert_density_equal, assert_statevec_equal,
+                      get_density, get_statevec, set_density, set_statevec)
+
+ENV = qt.createQuESTEnv()
+RNG = np.random.RandomState(77)
+DIM = 1 << NUM_QUBITS
+
+
+@pytest.fixture(params=["statevec", "density"])
+def qureg(request):
+    if request.param == "statevec":
+        q = qt.createQureg(NUM_QUBITS, ENV)
+    else:
+        q = qt.createDensityQureg(NUM_QUBITS, ENV)
+    yield q
+    qt.destroyQureg(q, ENV)
+
+
+def test_initBlankState(qureg):
+    qt.initBlankState(qureg)
+    assert np.all(qt.get_np(qureg) == 0)
+
+
+def test_initZeroState(qureg):
+    qt.initZeroState(qureg)
+    if qureg.is_density_matrix:
+        ref = np.zeros((DIM, DIM), dtype=complex)
+        ref[0, 0] = 1
+        assert_density_equal(qureg, ref)
+    else:
+        ref = np.zeros(DIM, dtype=complex)
+        ref[0] = 1
+        assert_statevec_equal(qureg, ref)
+
+
+def test_initPlusState(qureg):
+    qt.initPlusState(qureg)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, np.full((DIM, DIM), 1 / DIM, dtype=complex))
+    else:
+        assert_statevec_equal(qureg, np.full(DIM, 1 / np.sqrt(DIM), dtype=complex))
+
+
+@pytest.mark.parametrize("ind", [0, 1, DIM - 1, 13])
+def test_initClassicalState(qureg, ind):
+    qt.initClassicalState(qureg, ind)
+    if qureg.is_density_matrix:
+        ref = np.zeros((DIM, DIM), dtype=complex)
+        ref[ind, ind] = 1
+        assert_density_equal(qureg, ref)
+    else:
+        ref = np.zeros(DIM, dtype=complex)
+        ref[ind] = 1
+        assert_statevec_equal(qureg, ref)
+
+
+def test_initPureState(qureg):
+    pure = qt.createQureg(NUM_QUBITS, ENV)
+    vec = oracle.random_statevec(NUM_QUBITS, RNG)
+    set_statevec(pure, vec)
+    qt.initPureState(qureg, pure)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, np.outer(vec, vec.conj()))
+    else:
+        assert_statevec_equal(qureg, vec)
+    qt.destroyQureg(pure, ENV)
+
+
+def test_initDebugState(qureg):
+    qt.initDebugState(qureg)
+    ref = oracle.debug_statevec(qureg.num_amps_total)
+    got = qt.get_np(qureg)
+    assert np.allclose(got, ref)
+
+
+def test_initStateFromAmps(qureg):
+    n_amps = qureg.num_amps_total
+    re, im = RNG.randn(n_amps), RNG.randn(n_amps)
+    qt.initStateFromAmps(qureg, re, im)
+    assert np.allclose(qt.get_np(qureg), re + 1j * im)
+
+
+def test_setAmps():
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    qt.initZeroState(q)
+    re, im = [1.0, 2.0, 3.0], [4.0, 5.0, 6.0]
+    qt.setAmps(q, 5, re, im, 3)
+    got = get_statevec(q)
+    assert np.allclose(got[5:8], np.array(re) + 1j * np.array(im))
+    assert got[0] == 1 and np.all(got[1:5] == 0) and np.all(got[8:] == 0)
+    qt.destroyQureg(q, ENV)
+
+
+def test_setDensityAmps():
+    q = qt.createDensityQureg(NUM_QUBITS, ENV)
+    qt.initZeroState(q)
+    qt.setDensityAmps(q, 2, 1, [0.5], [0.25], 1)
+    rho = get_density(q)
+    assert rho[2, 1] == pytest.approx(0.5 + 0.25j)
+    qt.destroyQureg(q, ENV)
+
+
+def test_cloneQureg(qureg):
+    other = (qt.createDensityQureg(NUM_QUBITS, ENV) if qureg.is_density_matrix
+             else qt.createQureg(NUM_QUBITS, ENV))
+    qt.initDebugState(other)
+    qt.cloneQureg(qureg, other)
+    assert np.allclose(qt.get_np(qureg), qt.get_np(other))
+    qt.destroyQureg(other, ENV)
+
+
+def test_setWeightedQureg():
+    qs = [qt.createQureg(NUM_QUBITS, ENV) for _ in range(3)]
+    vecs = [oracle.random_statevec(NUM_QUBITS, RNG) for _ in range(3)]
+    for q, v in zip(qs, vecs):
+        set_statevec(q, v)
+    f1, f2, fo = 0.3 + 0.1j, -0.5j, 2.0
+    qt.setWeightedQureg(f1, qs[0], f2, qs[1], fo, qs[2])
+    assert_statevec_equal(qs[2], f1 * vecs[0] + f2 * vecs[1] + fo * vecs[2])
+    for q in qs:
+        qt.destroyQureg(q, ENV)
+
+
+def test_setQuregToPauliHamil():
+    q = qt.createDensityQureg(3, ENV)
+    hamil = qt.createPauliHamil(3, 2)
+    qt.initPauliHamil(hamil, [0.5, -1.2], [[1, 0, 3], [2, 2, 0]])
+    qt.setQuregToPauliHamil(q, hamil)
+    X, Y, Z, I = (oracle.pauli_matrix(c) for c in (1, 2, 3, 0))
+    ref = 0.5 * np.kron(Z, np.kron(I, X)) - 1.2 * np.kron(I, np.kron(Y, Y))
+    assert_density_equal(q, ref)
+    qt.destroyQureg(q, ENV)
+
+
+def test_getters(qureg):
+    qt.initDebugState(qureg)
+    if qureg.is_density_matrix:
+        assert qt.getDensityAmp(qureg, 1, 0) == pytest.approx(
+            oracle.debug_statevec(qureg.num_amps_total)[1])
+    else:
+        assert qt.getAmp(qureg, 3) == pytest.approx(0.6 + 0.7j)
+        assert qt.getRealAmp(qureg, 3) == pytest.approx(0.6)
+        assert qt.getImagAmp(qureg, 3) == pytest.approx(0.7)
+        assert qt.getProbAmp(qureg, 3) == pytest.approx(0.36 + 0.49)
+    assert qt.getNumQubits(qureg) == NUM_QUBITS
+
+
+def test_validation_bad_state_index(qureg):
+    with pytest.raises(qt.QuESTError, match="Invalid state index"):
+        qt.initClassicalState(qureg, DIM)
+    with pytest.raises(qt.QuESTError, match="Invalid state index"):
+        qt.initClassicalState(qureg, -1)

@@ -1,0 +1,413 @@
+"""Unitary gate correctness against the dense oracle.
+
+Follows the reference's test architecture (tests/test_unitaries.cpp, 42 cases):
+one test per API function, each checking state-vector and density-matrix
+semantics from the debug state, plus input validation via raised QuESTError.
+Qubit subsets are enumerated exhaustively where cheap (every target / every
+(control,target) pair of a 5-qubit register) and sampled where combinatorial.
+"""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+from . import oracle
+from .helpers import (NUM_QUBITS, assert_density_equal, assert_statevec_equal,
+                      debug_state_and_ref)
+
+ENV = qt.createQuESTEnv()
+RNG = np.random.RandomState(1234)
+
+ALL_TARGETS = list(range(NUM_QUBITS))
+CTRL_TARG_PAIRS = [(c, t) for c in ALL_TARGETS for t in ALL_TARGETS if c != t]
+
+
+@pytest.fixture(params=["statevec", "density"])
+def qureg(request):
+    if request.param == "statevec":
+        q = qt.createQureg(NUM_QUBITS, ENV)
+    else:
+        q = qt.createDensityQureg(NUM_QUBITS, ENV)
+    yield q
+    qt.destroyQureg(q, ENV)
+
+
+def check_gate(qureg, apply_fn, targets, matrix, controls=(), control_states=None):
+    """Run apply_fn on the debug state and compare to the oracle."""
+    ref = debug_state_and_ref(qureg)
+    apply_fn()
+    if qureg.is_density_matrix:
+        ref = oracle.apply_to_density(ref, NUM_QUBITS, targets, matrix,
+                                      controls, control_states)
+        assert_density_equal(qureg, ref)
+    else:
+        ref = oracle.apply_to_statevec(ref, NUM_QUBITS, targets, matrix,
+                                       controls, control_states)
+        assert_statevec_equal(qureg, ref)
+
+
+# ---------------------------------------------------------------------------
+# single-qubit gates, all targets
+# ---------------------------------------------------------------------------
+
+H = np.array([[1, 1], [1, -1]]) / math.sqrt(2)
+X = np.array([[0, 1], [1, 0]], dtype=complex)
+Y = np.array([[0, -1j], [1j, 0]])
+Z = np.diag([1, -1]).astype(complex)
+S = np.diag([1, 1j])
+T = np.diag([1, np.exp(1j * math.pi / 4)])
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_hadamard(qureg, target):
+    check_gate(qureg, lambda: qt.hadamard(qureg, target), (target,), H)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_pauliX(qureg, target):
+    check_gate(qureg, lambda: qt.pauliX(qureg, target), (target,), X)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_pauliY(qureg, target):
+    check_gate(qureg, lambda: qt.pauliY(qureg, target), (target,), Y)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_pauliZ(qureg, target):
+    check_gate(qureg, lambda: qt.pauliZ(qureg, target), (target,), Z)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_sGate(qureg, target):
+    check_gate(qureg, lambda: qt.sGate(qureg, target), (target,), S)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_tGate(qureg, target):
+    check_gate(qureg, lambda: qt.tGate(qureg, target), (target,), T)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_phaseShift(qureg, target):
+    theta = 0.7321
+    m = np.diag([1, np.exp(1j * theta)])
+    check_gate(qureg, lambda: qt.phaseShift(qureg, target, theta), (target,), m)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_rotateX(qureg, target):
+    theta = 0.921
+    m = np.array([[math.cos(theta / 2), -1j * math.sin(theta / 2)],
+                  [-1j * math.sin(theta / 2), math.cos(theta / 2)]])
+    check_gate(qureg, lambda: qt.rotateX(qureg, target, theta), (target,), m)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_rotateY(qureg, target):
+    theta = -1.14
+    m = np.array([[math.cos(theta / 2), -math.sin(theta / 2)],
+                  [math.sin(theta / 2), math.cos(theta / 2)]], dtype=complex)
+    check_gate(qureg, lambda: qt.rotateY(qureg, target, theta), (target,), m)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_rotateZ(qureg, target):
+    theta = 0.513
+    m = np.diag([np.exp(-1j * theta / 2), np.exp(1j * theta / 2)])
+    check_gate(qureg, lambda: qt.rotateZ(qureg, target, theta), (target,), m)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_rotateAroundAxis(qureg, target):
+    theta = 1.04
+    axis = qt.Vector(1.0, -2.0, 0.5)
+    mag = math.sqrt(1 + 4 + 0.25)
+    nx, ny, nz = 1 / mag, -2 / mag, 0.5 / mag
+    c, s = math.cos(theta / 2), math.sin(theta / 2)
+    m = np.array([[c - 1j * s * nz, -s * (ny + 1j * nx)],
+                  [s * (ny - 1j * nx), c + 1j * s * nz]])
+    check_gate(qureg, lambda: qt.rotateAroundAxis(qureg, target, theta, axis),
+               (target,), m)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_compactUnitary(qureg, target):
+    alpha = (0.3 + 0.4j)
+    beta = (0.5 + 0.1j)
+    norm = math.sqrt(abs(alpha) ** 2 + abs(beta) ** 2)
+    alpha, beta = alpha / norm, beta / norm
+    m = np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
+    check_gate(qureg, lambda: qt.compactUnitary(qureg, target, alpha, beta),
+               (target,), m)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_unitary(qureg, target):
+    u = oracle.random_unitary(1, RNG)
+    check_gate(qureg, lambda: qt.unitary(qureg, target, u), (target,), u)
+
+
+# ---------------------------------------------------------------------------
+# controlled gates, all (control, target) pairs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("control,target", CTRL_TARG_PAIRS)
+def test_controlledNot(qureg, control, target):
+    check_gate(qureg, lambda: qt.controlledNot(qureg, control, target),
+               (target,), X, controls=(control,))
+
+
+@pytest.mark.parametrize("control,target", CTRL_TARG_PAIRS)
+def test_controlledPauliY(qureg, control, target):
+    check_gate(qureg, lambda: qt.controlledPauliY(qureg, control, target),
+               (target,), Y, controls=(control,))
+
+
+@pytest.mark.parametrize("control,target", CTRL_TARG_PAIRS)
+def test_controlledPhaseShift(qureg, control, target):
+    theta = 0.41
+    m = np.diag([1, np.exp(1j * theta)])
+    check_gate(qureg, lambda: qt.controlledPhaseShift(qureg, control, target, theta),
+               (target,), m, controls=(control,))
+
+
+@pytest.mark.parametrize("control,target", CTRL_TARG_PAIRS[:8])
+def test_controlledUnitary(qureg, control, target):
+    u = oracle.random_unitary(1, RNG)
+    check_gate(qureg, lambda: qt.controlledUnitary(qureg, control, target, u),
+               (target,), u, controls=(control,))
+
+
+@pytest.mark.parametrize("control,target", CTRL_TARG_PAIRS[:8])
+def test_controlledRotateZ(qureg, control, target):
+    theta = -0.73
+    m = np.diag([np.exp(-1j * theta / 2), np.exp(1j * theta / 2)])
+    check_gate(qureg, lambda: qt.controlledRotateZ(qureg, control, target, theta),
+               (target,), m, controls=(control,))
+
+
+@pytest.mark.parametrize("control,target", CTRL_TARG_PAIRS)
+def test_controlledPhaseFlip(qureg, control, target):
+    check_gate(qureg, lambda: qt.controlledPhaseFlip(qureg, control, target),
+               (target,), Z, controls=(control,))
+
+
+def test_multiStateControlledUnitary(qureg):
+    u = oracle.random_unitary(1, RNG)
+    controls, states, target = (0, 2, 4), (0, 1, 0), 1
+    check_gate(qureg,
+               lambda: qt.multiStateControlledUnitary(qureg, controls, states, target, u),
+               (target,), u, controls=controls, control_states=states)
+
+
+# ---------------------------------------------------------------------------
+# multi-qubit gates: exhaustive small subsets, sampled larger ones
+# ---------------------------------------------------------------------------
+
+TWO_SUBSETS = list(itertools.permutations(ALL_TARGETS, 2))
+THREE_SUBSETS = list(itertools.permutations(ALL_TARGETS, 3))[::6]
+
+
+@pytest.mark.parametrize("t1,t2", TWO_SUBSETS)
+def test_swapGate(qureg, t1, t2):
+    m = np.eye(4)[[0, 2, 1, 3]].astype(complex)
+    check_gate(qureg, lambda: qt.swapGate(qureg, t1, t2), (t1, t2), m)
+
+
+@pytest.mark.parametrize("t1,t2", TWO_SUBSETS[:10])
+def test_sqrtSwapGate(qureg, t1, t2):
+    m = np.array([[1, 0, 0, 0],
+                  [0, 0.5 + 0.5j, 0.5 - 0.5j, 0],
+                  [0, 0.5 - 0.5j, 0.5 + 0.5j, 0],
+                  [0, 0, 0, 1]])
+    check_gate(qureg, lambda: qt.sqrtSwapGate(qureg, t1, t2), (t1, t2), m)
+
+
+@pytest.mark.parametrize("t1,t2", TWO_SUBSETS)
+def test_twoQubitUnitary(qureg, t1, t2):
+    u = oracle.random_unitary(2, RNG)
+    check_gate(qureg, lambda: qt.twoQubitUnitary(qureg, t1, t2, u), (t1, t2), u)
+
+
+@pytest.mark.parametrize("targets", THREE_SUBSETS)
+def test_multiQubitUnitary(qureg, targets):
+    u = oracle.random_unitary(3, RNG)
+    check_gate(qureg, lambda: qt.multiQubitUnitary(qureg, targets, u), targets, u)
+
+
+@pytest.mark.parametrize("control,t1,t2", [(0, 1, 2), (4, 3, 0), (2, 4, 1)])
+def test_controlledTwoQubitUnitary(qureg, control, t1, t2):
+    u = oracle.random_unitary(2, RNG)
+    check_gate(qureg, lambda: qt.controlledTwoQubitUnitary(qureg, control, t1, t2, u),
+               (t1, t2), u, controls=(control,))
+
+
+@pytest.mark.parametrize("controls,targets", [
+    ((0,), (1, 2)), ((0, 3), (1, 2)), ((4, 0), (2, 1)), ((1, 2, 3), (0, 4)),
+])
+def test_multiControlledTwoQubitUnitary(qureg, controls, targets):
+    u = oracle.random_unitary(2, RNG)
+    check_gate(qureg,
+               lambda: qt.multiControlledTwoQubitUnitary(qureg, controls, *targets, u),
+               targets, u, controls=controls)
+
+
+@pytest.mark.parametrize("controls,targets", [
+    ((0,), (1,)), ((0, 2), (3,)), ((4, 1), (0, 2)), ((3,), (4, 0, 1)),
+])
+def test_multiControlledMultiQubitUnitary(qureg, controls, targets):
+    u = oracle.random_unitary(len(targets), RNG)
+    check_gate(qureg,
+               lambda: qt.multiControlledMultiQubitUnitary(qureg, controls, targets, u),
+               targets, u, controls=controls)
+
+
+def test_controlledMultiQubitUnitary(qureg):
+    u = oracle.random_unitary(2, RNG)
+    check_gate(qureg, lambda: qt.controlledMultiQubitUnitary(qureg, 4, (0, 2), u),
+               (0, 2), u, controls=(4,))
+
+
+@pytest.mark.parametrize("controls", [(0,), (1, 3), (0, 2, 4)])
+def test_multiControlledUnitary(qureg, controls):
+    u = oracle.random_unitary(1, RNG)
+    target = 1 if 1 not in controls else 4
+    check_gate(qureg, lambda: qt.multiControlledUnitary(qureg, controls, target, u),
+               (target,), u, controls=controls)
+
+
+@pytest.mark.parametrize("targets", [(0,), (2, 4), (1, 0, 3)])
+def test_multiQubitNot(qureg, targets):
+    m = np.eye(1)
+    for _ in targets:
+        m = np.kron(X, m)
+    check_gate(qureg, lambda: qt.multiQubitNot(qureg, targets), targets, m)
+
+
+@pytest.mark.parametrize("controls,targets", [((1,), (0,)), ((0, 2), (3, 4))])
+def test_multiControlledMultiQubitNot(qureg, controls, targets):
+    m = np.eye(1)
+    for _ in targets:
+        m = np.kron(X, m)
+    check_gate(qureg,
+               lambda: qt.multiControlledMultiQubitNot(qureg, controls, targets),
+               targets, m, controls=controls)
+
+
+@pytest.mark.parametrize("qubits", [(0, 1), (2, 0, 4), (0, 1, 2, 3, 4)])
+def test_multiControlledPhaseFlip(qureg, qubits):
+    m = np.diag([1.0] * (2 ** len(qubits) - 1) + [-1.0]).astype(complex)
+    check_gate(qureg, lambda: qt.multiControlledPhaseFlip(qureg, qubits), qubits, m)
+
+
+@pytest.mark.parametrize("qubits", [(0, 1), (2, 0, 4), (0, 1, 2, 3, 4)])
+def test_multiControlledPhaseShift(qureg, qubits):
+    theta = 0.39
+    d = np.ones(2 ** len(qubits), dtype=complex)
+    d[-1] = np.exp(1j * theta)
+    check_gate(qureg, lambda: qt.multiControlledPhaseShift(qureg, qubits, theta),
+               qubits, np.diag(d))
+
+
+# ---------------------------------------------------------------------------
+# Pauli-string rotations
+# ---------------------------------------------------------------------------
+
+def _multi_rz_matrix(k, theta):
+    d = []
+    for i in range(1 << k):
+        par = bin(i).count("1") % 2
+        d.append(np.exp(-1j * theta / 2 * (1 - 2 * par)))
+    return np.diag(d)
+
+
+@pytest.mark.parametrize("qubits", [(0,), (1, 3), (0, 2, 4), (0, 1, 2, 3, 4)])
+def test_multiRotateZ(qureg, qubits):
+    theta = 0.77
+    check_gate(qureg, lambda: qt.multiRotateZ(qureg, qubits, theta),
+               qubits, _multi_rz_matrix(len(qubits), theta))
+
+
+@pytest.mark.parametrize("controls,targets", [((4,), (0, 2)), ((1, 3), (0,))])
+def test_multiControlledMultiRotateZ(qureg, controls, targets):
+    theta = -0.6
+    check_gate(qureg,
+               lambda: qt.multiControlledMultiRotateZ(qureg, controls, targets, theta),
+               targets, _multi_rz_matrix(len(targets), theta), controls=controls)
+
+
+def _pauli_rotation_matrix(codes, theta):
+    P = np.eye(1)
+    for c in reversed(codes):
+        P = np.kron(P, oracle.pauli_matrix(c))
+    dim = P.shape[0]
+    return math.cos(theta / 2) * np.eye(dim) - 1j * math.sin(theta / 2) * P
+
+
+@pytest.mark.parametrize("targets,codes", [
+    ((0,), (1,)), ((1,), (2,)), ((2,), (3,)),
+    ((0, 2), (1, 2)), ((1, 4), (2, 2)), ((3, 0), (3, 1)),
+    ((0, 1, 2), (1, 2, 3)),
+])
+def test_multiRotatePauli(qureg, targets, codes):
+    theta = 0.53
+    # build reference via dense P on ordered targets
+    m = _pauli_rotation_matrix(codes, theta)
+    check_gate(qureg, lambda: qt.multiRotatePauli(qureg, targets, codes, theta),
+               targets, m)
+
+
+@pytest.mark.parametrize("controls,targets,codes", [
+    ((3,), (0, 2), (1, 3)), ((0, 4), (1,), (2,)),
+])
+def test_multiControlledMultiRotatePauli(qureg, controls, targets, codes):
+    theta = 0.81
+    m = _pauli_rotation_matrix(codes, theta)
+    check_gate(qureg,
+               lambda: qt.multiControlledMultiRotatePauli(qureg, controls, targets, codes, theta),
+               targets, m, controls=controls)
+
+
+def test_diagonalUnitary(qureg):
+    op = qt.createSubDiagonalOp(2)
+    phases = np.exp(1j * np.array([0.1, 0.2, -0.5, 1.3]))
+    op.elems[:] = phases
+    check_gate(qureg, lambda: qt.diagonalUnitary(qureg, (1, 3), op),
+               (1, 3), np.diag(phases))
+
+
+# ---------------------------------------------------------------------------
+# input validation (reference pattern: REQUIRE_THROWS, tests/test_unitaries.cpp)
+# ---------------------------------------------------------------------------
+
+def test_validation_bad_target(qureg):
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.hadamard(qureg, NUM_QUBITS)
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.rotateX(qureg, -1, 0.3)
+
+
+def test_validation_ctrl_equals_target(qureg):
+    with pytest.raises(qt.QuESTError, match="Control qubit cannot equal target"):
+        qt.controlledNot(qureg, 2, 2)
+
+
+def test_validation_repeated_qubits(qureg):
+    with pytest.raises(qt.QuESTError, match="unique"):
+        qt.multiQubitNot(qureg, (0, 0))
+    with pytest.raises(qt.QuESTError, match="disjoint"):
+        u = oracle.random_unitary(1, RNG)
+        qt.multiControlledUnitary(qureg, (1,), 1, u)
+
+
+def test_validation_non_unitary(qureg):
+    bad = np.ones((2, 2), dtype=complex)
+    with pytest.raises(qt.QuESTError, match="unitary"):
+        qt.unitary(qureg, 0, bad)
+    with pytest.raises(qt.QuESTError, match="unitary"):
+        qt.compactUnitary(qureg, 0, 1.0, 1.0)
