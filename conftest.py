@@ -16,4 +16,8 @@ os.environ.setdefault("QUEST_PRECISION", "2")
 
 import jax  # noqa: E402
 
+# The axon TPU plugin exports JAX_PLATFORMS=axon at interpreter start, which
+# outranks the env vars above; the config update below is what actually pins
+# tests to the 8-device host mesh.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
