@@ -1,0 +1,90 @@
+"""Benchmark: gate-ops/sec on an N-qubit state-vector (BASELINE.json metric).
+
+Runs the same pseudo-random Clifford+T layer circuit as __graft_entry__
+(H/T/Rz/Rx layers + CNOT ladders + long-range CZ), fused into one XLA
+program per depth block, on the default JAX backend (the real TPU chip when
+run by the driver).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline compares against the reference QuEST (/root/reference) compiled
+-O3 -DMULTITHREADED=1 and timed on this host's CPU with the identical circuit
+shape (tools/ref_bench.c); measured 2026-07-29 on the 1-core build host:
+
+    qubits->gates/sec: {20: 422.99, 24: 23.42, 26: 5.86}
+
+(The reference cannot run its CUDA backend here and cannot combine
+CUDA with MPI at all -- QuEST/CMakeLists.txt:64-68 -- so host CPU is the
+available anchor; see BASELINE.md.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+#: reference QuEST gates/sec on this host (see module docstring)
+REF_GATES_PER_SEC = {20: 422.99, 24: 23.42, 26: 5.86}
+
+
+def build_circuit(n: int, depth: int):
+    from quest_tpu.circuits import Circuit
+    from __graft_entry__ import _random_layers
+
+    circ = Circuit(n)
+    _random_layers(circ, n, depth)
+    return circ
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--qubits", type=int, default=26)
+    p.add_argument("--depth", type=int, default=8)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes for CI (12 qubits, depth 2)")
+    args = p.parse_args()
+    if args.smoke:
+        args.qubits, args.depth = 12, 2
+
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.ops import init as ops_init
+
+    n, depth = args.qubits, args.depth
+    circ = build_circuit(n, depth)
+    num_gates = len(circ)
+    fn = circ.compiled(donate=True)
+
+    amps = ops_init.init_classical(1 << n, jnp.dtype("float32"), 0)
+    amps = fn(amps)  # compile + warmup
+    amps.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        amps = fn(amps)
+    amps.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    gates_per_sec = num_gates * args.reps / dt
+    ref = REF_GATES_PER_SEC.get(n)
+    vs_baseline = round(gates_per_sec / ref, 3) if ref else None
+
+    dev = jax.devices()[0]
+    print(f"# {num_gates} gates x {args.reps} reps on {n}q in {dt:.3f}s "
+          f"on {dev.device_kind}", file=sys.stderr)
+    print(json.dumps({
+        "metric": f"gate-ops/sec, {n}-qubit state-vector random Clifford+T",
+        "value": round(gates_per_sec, 2),
+        "unit": "gates/sec",
+        "vs_baseline": vs_baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Bernstein–Vazirani: recover a secret bitstring with one oracle query
+(behavioural port of the reference's examples/bernstein_vazirani_circuit.c).
+
+Qubit 0 is the ancilla; qubits 1..n-1 hold the query register.
+"""
+
+import random
+import time
+
+import _bootstrap  # noqa: F401  (repo path + QUEST_PLATFORM handling)
+
+import quest_tpu as qt
+
+
+def apply_oracle(qureg, num_qubits: int, secret: int) -> None:
+    bits = secret
+    for q in range(1, num_qubits):
+        if bits % 2:
+            qt.controlledNot(qureg, q, 0)
+        bits //= 2
+
+
+def main(num_qubits: int = 15) -> None:
+    env = qt.createQuESTEnv()
+    random.seed(time.time())
+    secret = random.randrange(2 ** (num_qubits - 1))
+
+    qureg = qt.createQureg(num_qubits, env)
+    qt.initZeroState(qureg)
+
+    # prepare ancilla in |-> and query register in |+>
+    qt.pauliX(qureg, 0)
+    for q in range(num_qubits):
+        qt.hadamard(qureg, q)
+
+    apply_oracle(qureg, num_qubits, secret)
+
+    for q in range(num_qubits):
+        qt.hadamard(qureg, q)
+
+    # state is now |secret>|1>
+    ind = 2 * secret + 1
+    prob = qt.getProbAmp(qureg, ind)
+    print(f"success probability: {prob:.10f}")
+    assert prob > 0.99
+
+    qt.destroyQureg(qureg, env)
+    qt.destroyQuESTEnv(env)
+
+
+if __name__ == "__main__":
+    main()

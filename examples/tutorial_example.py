@@ -1,0 +1,63 @@
+"""Tutorial: a basic 3-qubit circuit (port of the reference's
+examples/tutorial_example.c behaviour to the quest_tpu Python API)."""
+
+import numpy as np
+
+import _bootstrap  # noqa: F401  (repo path + QUEST_PLATFORM handling)
+
+import quest_tpu as qt
+
+env = qt.createQuESTEnv()
+
+print("-" * 55)
+print("Running quest_tpu tutorial:\n\t Basic circuit involving a system of 3 qubits.")
+print("-" * 55)
+
+qubits = qt.createQureg(3, env)
+qt.initZeroState(qubits)
+
+print("\nThis is our environment:")
+qt.reportQuregParams(qubits)
+qt.reportQuESTEnv(env)
+
+# apply circuit
+qt.hadamard(qubits, 0)
+qt.controlledNot(qubits, 0, 1)
+qt.rotateY(qubits, 2, 0.1)
+
+qt.multiControlledPhaseFlip(qubits, [0, 1, 2])
+
+u = np.array([[0.5 + 0.5j, 0.5 - 0.5j],
+              [0.5 - 0.5j, 0.5 + 0.5j]])
+qt.unitary(qubits, 0, u)
+
+a, b = 0.5 + 0.5j, 0.5 - 0.5j
+qt.compactUnitary(qubits, 1, a, b)
+
+qt.rotateAroundAxis(qubits, 2, 3.14 / 2, qt.Vector(1, 0, 0))
+
+qt.controlledCompactUnitary(qubits, 0, 1, a, b)
+
+qt.multiControlledUnitary(qubits, [0, 1], 2, u)
+
+toff = np.eye(8)
+toff[6, 6] = toff[7, 7] = 0
+toff[6, 7] = toff[7, 6] = 1
+qt.multiQubitUnitary(qubits, [0, 1, 2], toff)
+
+# study the output
+print("\nCircuit output:")
+prob = qt.getProbAmp(qubits, 7)
+print(f"Probability amplitude of |111>: {prob}")
+
+prob = qt.calcProbOfOutcome(qubits, 2, 1)
+print(f"Probability of qubit 2 being in state 1: {prob}")
+
+outcome = qt.measure(qubits, 0)
+print(f"Qubit 0 was measured in state {outcome}")
+
+outcome, prob = qt.measureWithStats(qubits, 2)
+print(f"Qubit 2 collapsed to {outcome} with probability {prob}")
+
+qt.destroyQureg(qubits, env)
+qt.destroyQuESTEnv(env)

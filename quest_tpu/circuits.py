@@ -1,0 +1,153 @@
+"""Circuit: a recorded gate tape compiled into ONE fused XLA program.
+
+The reference applies gates eagerly, one kernel launch (and, when distributed,
+one MPI exchange) per gate -- its whole cost model is per-gate
+(QuEST_cpu_distributed.c:870-905). On TPU the dominant cost of that scheme is
+neither FLOPs nor bandwidth but per-dispatch overhead and lost fusion: XLA
+fuses runs of elementwise/diagonal gates into single HBM passes and overlaps
+collective traffic with compute *within* one compiled program, never across
+programs.
+
+``Circuit`` is therefore the TPU-native execution unit: record the same L5
+API calls (same names, same argument order as ``QuEST.h``) against a tape,
+then replay the tape symbolically through one ``jax.jit``. Validation and
+matrix construction happen once at trace time on the host; the device sees a
+single fused program. Eager per-gate application (the reference's model)
+remains available by simply calling the API functions directly.
+
+Measurement and host-returning calculations are excluded from tapes (they
+need host control flow / RNG); use the eager API for those, or
+``lax.cond``-based collapse via ``collapseToOutcome`` eagerly between
+circuits.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+from .registers import Qureg
+
+#: API names that can be recorded on a tape: mutate qureg.amps, need no host
+#: round-trip at run time. (measure/collapse and calc* are excluded.)
+_TAPEABLE_MODULES = ("gates", "operators", "decoherence", "state_init")
+_EXCLUDED = {
+    "measure", "measureWithStats", "collapseToOutcome",
+    # these need host data or aren't pure amps->amps
+    "createDiagonalOp", "destroyDiagonalOp", "syncDiagonalOp",
+    "initDiagonalOp", "setDiagonalOpElems", "initDiagonalOpFromPauliHamil",
+    "createDiagonalOpFromPauliHamilFile", "calcExpecDiagonalOp",
+    "initStateFromAmps", "setAmps", "setDensityAmps",
+}
+
+
+def _tape_compatible(fn) -> bool:
+    """True iff ``fn``'s signature fits the tape contract: the target Qureg
+    is the sole Qureg argument and comes first. Functions taking a second
+    register (initPureState, cloneQureg, setWeightedQureg, applyPauliSum,
+    mixDensityMatrix, ...) would either leak jit tracers into the other
+    register or bake its amplitudes into the executable as a stale constant.
+    """
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    if not params:
+        return False
+
+    def is_qureg(p):
+        return "Qureg" in str(p.annotation) or "qureg" in p.name.lower()
+
+    return is_qureg(params[0]) and not any(is_qureg(p) for p in params[1:])
+
+
+def _resolve(name):
+    import importlib
+    for mod_name in _TAPEABLE_MODULES:
+        mod = importlib.import_module(f".{mod_name}", __package__)
+        fn = getattr(mod, name, None)
+        if fn is not None and callable(fn):
+            if not _tape_compatible(fn):
+                raise AttributeError(
+                    f"'{name}' takes a second Qureg (or none first); it must "
+                    f"run eagerly, not on a Circuit tape")
+            return fn
+    raise AttributeError(
+        f"'{name}' is not a tapeable quest_tpu API function "
+        f"(measurement and calc* functions must run eagerly)")
+
+
+class Circuit:
+    """Deferred-execution circuit over ``num_qubits`` qubits.
+
+    Usage::
+
+        c = Circuit(3)
+        c.hadamard(0)
+        c.controlledNot(0, 1)
+        c.run(qureg)           # compiles once, then reuses the executable
+
+    Any L5 gate/operator/decoherence/init function is available as a method
+    (without the leading ``qureg`` argument).
+    """
+
+    def __init__(self, num_qubits: int, is_density_matrix: bool = False):
+        self.num_qubits = int(num_qubits)
+        self.is_density_matrix = bool(is_density_matrix)
+        self._tape: list = []
+        self._fn = None
+
+    # -- recording ----------------------------------------------------------
+
+    def __getattr__(self, name):
+        if name.startswith("_") or name in _EXCLUDED:
+            raise AttributeError(name)
+        fn = _resolve(name)
+
+        def record(*args, **kwargs):
+            self.append(fn, *args, **kwargs)
+
+        record.__name__ = name
+        return record
+
+    def append(self, fn, *args, **kwargs) -> "Circuit":
+        """Record ``fn(qureg, *args, **kwargs)`` on the tape."""
+        self._tape.append((fn, args, kwargs))
+        self._fn = None
+        return self
+
+    def __len__(self) -> int:
+        return len(self._tape)
+
+    # -- execution ----------------------------------------------------------
+
+    def as_fn(self):
+        """Pure amps->amps function replaying the tape (jit-compatible)."""
+        tape = tuple(self._tape)
+        num_qubits, is_density = self.num_qubits, self.is_density_matrix
+
+        def fn(amps):
+            shell = Qureg(num_qubits, is_density, amps, env=None)
+            for f, args, kwargs in tape:
+                f(shell, *args, **kwargs)
+            return shell.amps
+
+        return fn
+
+    def compiled(self, donate: bool = True):
+        """The tape as one jitted executable (cached on the circuit)."""
+        if self._fn is None:
+            self._fn = jax.jit(self.as_fn(),
+                               donate_argnums=(0,) if donate else ())
+        return self._fn
+
+    def run(self, qureg: Qureg) -> Qureg:
+        """Apply the circuit to ``qureg`` (mutates its amps, like the C API)."""
+        if qureg.num_qubits_represented != self.num_qubits or \
+           qureg.is_density_matrix != self.is_density_matrix:
+            raise ValueError(
+                f"Circuit({self.num_qubits}q, density={self.is_density_matrix}) "
+                f"cannot run on {qureg!r}")
+        qureg.put(self.compiled()(qureg.amps))
+        return qureg

@@ -20,7 +20,9 @@ __all__ = [
 
 
 def _put_shaped(qureg: Qureg, amps) -> None:
-    sharding = qureg.env.sharding(qureg.num_amps_total)
+    # env is None when replaying on a Circuit tape (inside jit): there the
+    # outer program's sharding propagates via GSPMD and device_put is illegal.
+    sharding = qureg.env.sharding(qureg.num_amps_total) if qureg.env is not None else None
     if sharding is not None:
         amps = jax.device_put(amps, sharding)
     qureg.put(amps)

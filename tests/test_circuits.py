@@ -1,0 +1,118 @@
+"""Circuit tape vs eager API equivalence.
+
+The reference has no circuit abstraction (all gates eager); the tape is the
+TPU-native execution unit, so its contract is: identical amplitudes to the
+same sequence of eager L5 calls (test model: SURVEY.md section 4 oracle
+strategy).
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+ENV = qt.createQuESTEnv()
+
+
+def _random_unitary(rng, dim):
+    m = rng.normal(size=(dim, dim)) + 1j * rng.normal(size=(dim, dim))
+    q, r = np.linalg.qr(m)
+    return q * (np.diag(r) / np.abs(np.diag(r)))
+
+
+@pytest.mark.parametrize("density", [False, True])
+def test_circuit_matches_eager(density):
+    n = 4
+    rng = np.random.RandomState(7)
+    u2 = _random_unitary(rng, 2)
+    u4 = _random_unitary(rng, 4)
+
+    def build(record):
+        record.hadamard(0)
+        record.controlledNot(0, 2)
+        record.rotateZ(3, 0.37)
+        record.unitary(1, u2)
+        record.twoQubitUnitary(2, 3, u4)
+        record.multiControlledPhaseFlip([0, 1, 3])
+        record.tGate(2)
+        record.multiRotateZ([0, 2], -0.81)
+
+    class Eager:
+        """Adapter giving the eager API the circuit-method call shape."""
+        def __init__(self, qureg):
+            self.qureg = qureg
+        def __getattr__(self, name):
+            fn = getattr(qt, name)
+            return lambda *a, **k: fn(self.qureg, *a, **k)
+
+    make = qt.createDensityQureg if density else qt.createQureg
+    q_eager = make(n, ENV)
+    qt.initDebugState(q_eager)
+    build(Eager(q_eager))
+
+    q_tape = make(n, ENV)
+    qt.initDebugState(q_tape)
+    circ = qt.Circuit(n, is_density_matrix=density)
+    build(circ)
+    assert len(circ) == 8
+    circ.run(q_tape)
+
+    np.testing.assert_allclose(qt.get_np(q_tape), qt.get_np(q_eager),
+                               atol=1e-12)
+
+
+def test_circuit_reuse_and_decoherence():
+    n = 3
+    circ = qt.Circuit(n, is_density_matrix=True)
+    circ.hadamard(0)
+    circ.mixDephasing(0, 0.3)
+    circ.mixDepolarising(1, 0.2)
+
+    for _ in range(2):  # second run reuses the compiled executable
+        q = qt.createDensityQureg(n, ENV)
+        qt.initZeroState(q)
+        circ.run(q)
+        assert abs(qt.calcTotalProb(q) - 1.0) < 1e-12
+
+    q2 = qt.createDensityQureg(n, ENV)
+    qt.initZeroState(q2)
+    qt.hadamard(q2, 0)
+    qt.mixDephasing(q2, 0, 0.3)
+    qt.mixDepolarising(q2, 1, 0.2)
+    np.testing.assert_allclose(qt.get_np(q), qt.get_np(q2), atol=1e-12)
+
+
+def test_circuit_init_on_tape():
+    circ = qt.Circuit(2)
+    circ.initPlusState()
+    circ.pauliZ(1)
+    q = qt.createQureg(2, ENV)
+    circ.run(q)
+    got = qt.get_np(q)
+    np.testing.assert_allclose(got, np.array([0.5, 0.5, -0.5, -0.5]), atol=1e-12)
+
+
+def test_circuit_rejects_mismatched_qureg():
+    circ = qt.Circuit(3)
+    circ.hadamard(0)
+    q = qt.createQureg(4, ENV)
+    with pytest.raises(ValueError):
+        circ.run(q)
+
+
+def test_circuit_rejects_untapeable():
+    circ = qt.Circuit(2)
+    with pytest.raises(AttributeError):
+        circ.measure(0)
+
+
+@pytest.mark.parametrize("name", [
+    "initPureState", "cloneQureg", "setWeightedQureg",
+    "applyPauliSum", "applyPauliHamil", "mixDensityMatrix",
+])
+def test_circuit_rejects_second_qureg_functions(name):
+    """Functions taking a second register would leak tracers / bake stale
+    constants if taped; the tape must refuse them."""
+    circ = qt.Circuit(2)
+    with pytest.raises(AttributeError):
+        getattr(circ, name)
