@@ -43,6 +43,7 @@ from .validation import (  # noqa: F401
     QuESTError, invalid_quest_input_error, set_input_error_handler,
 )
 from .circuits import Circuit  # noqa: F401
+from .parallel.scheduler import explicit_mesh, plan_circuit  # noqa: F401
 from .state_init import *  # noqa: F401,F403
 from .gates import *  # noqa: F401,F403
 from .calculations import *  # noqa: F401,F403

@@ -96,7 +96,7 @@ class Circuit:
         self.num_qubits = int(num_qubits)
         self.is_density_matrix = bool(is_density_matrix)
         self._tape: list = []
-        self._fn = None
+        self._compiled: dict = {}
 
     # -- recording ----------------------------------------------------------
 
@@ -114,7 +114,7 @@ class Circuit:
     def append(self, fn, *args, **kwargs) -> "Circuit":
         """Record ``fn(qureg, *args, **kwargs)`` on the tape."""
         self._tape.append((fn, args, kwargs))
-        self._fn = None
+        self._compiled.clear()
         return self
 
     def __len__(self) -> int:
@@ -136,11 +136,20 @@ class Circuit:
         return fn
 
     def compiled(self, donate: bool = True):
-        """The tape as one jitted executable (cached on the circuit)."""
-        if self._fn is None:
-            self._fn = jax.jit(self.as_fn(),
-                               donate_argnums=(0,) if donate else ())
-        return self._fn
+        """The tape as one jitted executable, cached per execution mode.
+
+        Gate routing (default GSPMD vs the explicit_mesh scheduler) is
+        trace-time state, so the cache is keyed on the active scheduler's
+        mesh -- entering/leaving ``explicit_mesh`` retraces rather than
+        silently replaying the other mode's executable.
+        """
+        from .parallel import scheduler as _dist
+        sched = _dist.active()
+        key = (donate, sched.mesh if sched else None)
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(
+                self.as_fn(), donate_argnums=(0,) if donate else ())
+        return self._compiled[key]
 
     def run(self, qureg: Qureg) -> Qureg:
         """Apply the circuit to ``qureg`` (mutates its amps, like the C API)."""

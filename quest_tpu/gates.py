@@ -19,6 +19,7 @@ import numpy as np
 from . import matrices, validation as V
 from .datatypes import SubDiagonalOp, Vector
 from .ops import apply as K, cplx, diagonal as D, measure as M
+from .parallel import scheduler as _dist
 from .registers import Qureg
 
 __all__ = [
@@ -50,17 +51,20 @@ def _shift(qs, n):
 
 def _apply_gate_matrix(qureg: Qureg, matrix, targets, controls=(), states=()):
     """Gate semantics: U on a state-vector; U . U^dagger on a density matrix
-    via the conj-shadow (QuEST.c:184-193)."""
+    via the conj-shadow (QuEST.c:184-193). Routed through the explicit
+    distributed scheduler when an ``explicit_mesh`` context is active."""
     n = qureg.num_qubits_represented
     nsv = qureg.num_qubits_in_state_vec
     targets, controls, states = tuple(targets), tuple(controls), tuple(states)
     m = cplx.from_complex(matrix, qureg.dtype)
-    amps = K.apply_matrix(qureg.amps, m, n=nsv, targets=targets,
-                          controls=controls, control_states=states)
+    sched = _dist.active()
+    apply = sched.apply_matrix if sched else K.apply_matrix
+    amps = apply(qureg.amps, m, n=nsv, targets=targets,
+                 controls=controls, control_states=states)
     if qureg.is_density_matrix:
-        amps = K.apply_matrix(amps, m, n=nsv, targets=_shift(targets, n),
-                              controls=_shift(controls, n), control_states=states,
-                              conj=True)
+        amps = apply(amps, m, n=nsv, targets=_shift(targets, n),
+                     controls=_shift(controls, n), control_states=states,
+                     conj=True)
     qureg.put(amps)
 
 
@@ -69,10 +73,12 @@ def _apply_gate_diag(qureg: Qureg, diag, targets, controls=()):
     nsv = qureg.num_qubits_in_state_vec
     targets, controls = tuple(targets), tuple(controls)
     d = cplx.from_complex(diag, qureg.dtype)
-    amps = D.apply_diagonal(qureg.amps, d, n=nsv, targets=targets, controls=controls)
+    sched = _dist.active()
+    apply = sched.apply_diagonal if sched else D.apply_diagonal
+    amps = apply(qureg.amps, d, n=nsv, targets=targets, controls=controls)
     if qureg.is_density_matrix:
-        amps = D.apply_diagonal(amps, d, n=nsv, targets=_shift(targets, n),
-                                controls=_shift(controls, n), conj=True)
+        amps = apply(amps, d, n=nsv, targets=_shift(targets, n),
+                     controls=_shift(controls, n), conj=True)
     qureg.put(amps)
 
 
@@ -80,11 +86,13 @@ def _apply_gate_x(qureg: Qureg, targets, controls=(), states=()):
     n = qureg.num_qubits_represented
     nsv = qureg.num_qubits_in_state_vec
     targets, controls, states = tuple(targets), tuple(controls), tuple(states)
-    amps = K.apply_x_class(qureg.amps, n=nsv, targets=targets,
-                           controls=controls, control_states=states)
+    sched = _dist.active()
+    apply = sched.apply_x if sched else K.apply_x_class
+    amps = apply(qureg.amps, n=nsv, targets=targets,
+                 controls=controls, control_states=states)
     if qureg.is_density_matrix:
-        amps = K.apply_x_class(amps, n=nsv, targets=_shift(targets, n),
-                               controls=_shift(controls, n), control_states=states)
+        amps = apply(amps, n=nsv, targets=_shift(targets, n),
+                     controls=_shift(controls, n), control_states=states)
     qureg.put(amps)
 
 
@@ -92,10 +100,12 @@ def _apply_gate_parity_phase(qureg: Qureg, theta, qubits, controls=()):
     n = qureg.num_qubits_represented
     nsv = qureg.num_qubits_in_state_vec
     qubits, controls = tuple(qubits), tuple(controls)
-    amps = D.apply_parity_phase(qureg.amps, theta, n=nsv, qubits=qubits, controls=controls)
+    sched = _dist.active()
+    apply = sched.apply_parity_phase if sched else D.apply_parity_phase
+    amps = apply(qureg.amps, theta, n=nsv, qubits=qubits, controls=controls)
     if qureg.is_density_matrix:
-        amps = D.apply_parity_phase(amps, theta, n=nsv, qubits=_shift(qubits, n),
-                                    controls=_shift(controls, n), conj=True)
+        amps = apply(amps, theta, n=nsv, qubits=_shift(qubits, n),
+                     controls=_shift(controls, n), conj=True)
     qureg.put(amps)
 
 
@@ -396,9 +406,11 @@ def swapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
     V.validate_unique_targets(qureg, qb1, qb2, "swapGate")
     n = qureg.num_qubits_represented
     nsv = qureg.num_qubits_in_state_vec
-    amps = K.apply_swap(qureg.amps, n=nsv, qb1=qb1, qb2=qb2)
+    sched = _dist.active()
+    apply = sched.apply_swap if sched else K.apply_swap
+    amps = apply(qureg.amps, n=nsv, qb1=qb1, qb2=qb2)
     if qureg.is_density_matrix:
-        amps = K.apply_swap(amps, n=nsv, qb1=qb1 + n, qb2=qb2 + n)
+        amps = apply(amps, n=nsv, qb1=qb1 + n, qb2=qb2 + n)
     qureg.put(amps)
     _record(qureg, "swap", (qb1, qb2))
 

@@ -1,0 +1,28 @@
+"""Explicit distribution layer: the TPU-native analogue of the reference's
+MPI backend (QuEST/src/CPU/QuEST_cpu_distributed.c).
+
+Two ways to run sharded:
+
+1. **GSPMD (default)** — the amplitude array carries a ``NamedSharding``;
+   every kernel in :mod:`quest_tpu.ops` is sharding-agnostic and XLA inserts
+   the collectives. Zero code, good baseline.
+2. **Explicit (this package)** — ``shard_map`` kernels that spell out the
+   reference's communication protocol in XLA collectives: the pairwise chunk
+   exchange (`exchangeStateVectors` -> ``lax.ppermute``), rank-conditional
+   half-updates (`getRotAngle`), the odd-parity swap relocation
+   (`statevec_swapQubitAmps`, applied out and back around each non-local
+   multi-target gate, as the reference does), and comm-free rank-masked
+   phases. Sharded *controls* additionally never travel (device-index
+   predicates) -- an improvement over shipping them through the exchange.
+   A lazy logical->physical qubit permutation that amortises the swap-backs
+   is the next planned optimisation, not yet implemented.
+"""
+
+from .mesh import shard_info, local_qubit_count  # noqa: F401
+from .exchange import (  # noqa: F401
+    dist_apply_matrix1, dist_apply_x, dist_apply_diag_phase,
+    dist_apply_parity_phase, dist_apply_local_matrix, dist_swap,
+)
+from .scheduler import (  # noqa: F401
+    DistributedScheduler, active, explicit_mesh, plan_circuit,
+)

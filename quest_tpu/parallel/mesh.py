@@ -1,0 +1,30 @@
+"""Mesh/shard bookkeeping.
+
+The state (2, 2^n) is block-sharded over the 1-D ``amps`` mesh axis into
+D = 2^d chunks, exactly the reference's rank partition
+(``numAmpsPerChunk = 2^n / numRanks``, QuEST_cpu.c:1296-1319): device r holds
+flat indices [r*C, (r+1)*C), C = 2^(n-d). Hence qubit q is **local** iff
+q < n - d (its amplitude pairs lie within one chunk -- the reference's
+``halfMatrixBlockFitsInChunk`` predicate, QuEST_cpu_distributed.c:372-377),
+and a **sharded** qubit q >= n - d is bit (q - (n-d)) of the device index.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh
+
+from ..environment import AMP_AXIS
+
+
+def local_qubit_count(n: int, mesh: Mesh | None) -> int:
+    """Number of low qubits entirely local to each shard."""
+    if mesh is None or mesh.size == 1:
+        return n
+    d = (mesh.size - 1).bit_length()
+    return n - d
+
+
+def shard_info(n: int, mesh: Mesh | None):
+    """(num_local_qubits, num_shard_qubits, axis_name)."""
+    nl = local_qubit_count(n, mesh)
+    return nl, n - nl, AMP_AXIS
