@@ -145,10 +145,19 @@ class Circuit:
         """
         from .parallel import scheduler as _dist
         sched = _dist.active()
-        key = (donate, sched.mesh if sched else None)
+        mesh = sched.mesh if sched else None
+        key = (donate, mesh)
         if key not in self._compiled:
-            self._compiled[key] = jax.jit(
-                self.as_fn(), donate_argnums=(0,) if donate else ())
+            inner = jax.jit(self.as_fn(), donate_argnums=(0,) if donate else ())
+
+            def fn(amps, _inner=inner, _mesh=mesh):
+                # jit traces on first *call*, which may happen under a
+                # different scheduler context than the one this executable is
+                # keyed on -- pin the mode captured here before invoking.
+                with _dist.explicit_mesh(_mesh):
+                    return _inner(amps)
+
+            self._compiled[key] = fn
         return self._compiled[key]
 
     def run(self, qureg: Qureg) -> Qureg:

@@ -398,7 +398,7 @@ def applyParamNamedPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_
     n = qureg.num_qubits_represented
     dt = qureg.dtype
     # pad params so indexed accesses (params[2+r] etc.) are always in range
-    padded = list(map(float, params)) + [0.0] * (2 + 2 * n_regs)
+    padded = list(map(float, params)) + [0.0] * (2 + 2 * len(reg_sizes))
     params_d = jnp.asarray(padded, dtype=dt)
     ovr_i = jnp.asarray(np.asarray(override_inds, dtype=np.float64), dtype=dt)
     ovr_p = jnp.asarray(np.asarray(override_phases, dtype=np.float64), dtype=dt)

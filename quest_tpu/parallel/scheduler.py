@@ -129,6 +129,12 @@ class DistributedScheduler:
 @contextmanager
 def explicit_mesh(mesh: Mesh):
     """Route L5 gate application through the explicit shard_map kernels."""
+    from ..environment import AMP_AXIS
+    if mesh is not None and mesh.size > 1 and AMP_AXIS not in mesh.shape:
+        raise ValueError(
+            f"explicit_mesh requires a mesh whose amplitude axis is named "
+            f"'{AMP_AXIS}' (got axes {tuple(mesh.shape)}); build it with "
+            f"createQuESTEnv or Mesh(devices, ('{AMP_AXIS}',))")
     sched = DistributedScheduler(mesh) if mesh is not None and mesh.size > 1 else None
     prev = getattr(_STATE, "sched", None)
     _STATE.sched = sched
@@ -147,7 +153,6 @@ def plan_circuit(circuit, mesh: Mesh):
     """Trace ``circuit`` abstractly under the explicit scheduler and return
     its communication plan stats (no device execution -- jax.eval_shape)."""
     import jax
-    import jax.numpy as jnp
 
     from ..precision import real_dtype
 

@@ -1,0 +1,695 @@
+"""Operator-layer correctness against the dense oracle.
+
+Mirrors the reference's tests/test_operators.cpp (23 cases): applyMatrix*,
+applyPauliSum/Hamil, applyTrotterCircuit, applyQFT, applyProjector, the
+Diagonal/SubDiagonal operators, and the full phase-function family.
+The phase-function oracle below is a per-index scalar loop, algorithmically
+distinct from the broadcast kernel in quest_tpu.ops.phasefunc.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import bitEncoding, phaseFunc
+
+from . import oracle
+from .helpers import (NUM_QUBITS, assert_density_equal, assert_statevec_equal,
+                      debug_state_and_ref, get_density, get_statevec)
+
+ENV = qt.createQuESTEnv()
+RNG = np.random.RandomState(99)
+
+DIM = 1 << NUM_QUBITS
+
+
+@pytest.fixture(params=["statevec", "density"])
+def qureg(request):
+    if request.param == "statevec":
+        q = qt.createQureg(NUM_QUBITS, ENV)
+    else:
+        q = qt.createDensityQureg(NUM_QUBITS, ENV)
+    yield q
+    qt.destroyQureg(q, ENV)
+
+
+@pytest.fixture
+def statevec():
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    yield q
+    qt.destroyQureg(q, ENV)
+
+
+@pytest.fixture
+def density():
+    q = qt.createDensityQureg(NUM_QUBITS, ENV)
+    yield q
+    qt.destroyQureg(q, ENV)
+
+
+def check_left_apply(qureg, apply_fn, targets, matrix, controls=()):
+    """apply* (non-Gate) semantics: M|psi> or M.rho (left mult only)."""
+    ref = debug_state_and_ref(qureg)
+    apply_fn()
+    F = oracle.full_operator(NUM_QUBITS, targets, matrix, controls)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, F @ ref)
+    else:
+        assert_statevec_equal(qureg, F @ ref)
+
+
+def check_gate_apply(qureg, apply_fn, targets, matrix, controls=()):
+    """applyGate* semantics: M|psi> or M.rho.M^dagger."""
+    ref = debug_state_and_ref(qureg)
+    apply_fn()
+    F = oracle.full_operator(NUM_QUBITS, targets, matrix, controls)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, F @ ref @ F.conj().T)
+    else:
+        assert_statevec_equal(qureg, F @ ref)
+
+
+# ---------------------------------------------------------------------------
+# direct matrix application
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("target", range(NUM_QUBITS))
+def test_applyMatrix2(qureg, target):
+    m = RNG.randn(2, 2) + 1j * RNG.randn(2, 2)  # deliberately non-unitary
+    check_left_apply(qureg, lambda: qt.applyMatrix2(qureg, target, m), (target,), m)
+
+
+@pytest.mark.parametrize("targs", [(0, 1), (1, 0), (2, 4), (4, 2), (3, 1)])
+def test_applyMatrix4(qureg, targs):
+    m = RNG.randn(4, 4) + 1j * RNG.randn(4, 4)
+    check_left_apply(qureg, lambda: qt.applyMatrix4(qureg, targs[0], targs[1], m),
+                     targs, m)
+
+
+@pytest.mark.parametrize("targets", [(0,), (2, 0), (1, 3, 4), (4, 2, 0, 1)])
+def test_applyMatrixN(qureg, targets):
+    t = len(targets)
+    m = RNG.randn(1 << t, 1 << t) + 1j * RNG.randn(1 << t, 1 << t)
+    check_left_apply(qureg, lambda: qt.applyMatrixN(qureg, list(targets), m),
+                     targets, m)
+
+
+@pytest.mark.parametrize("targets", [(0,), (1, 3), (4, 0, 2)])
+def test_applyGateMatrixN(qureg, targets):
+    t = len(targets)
+    m = RNG.randn(1 << t, 1 << t) + 1j * RNG.randn(1 << t, 1 << t)
+    check_gate_apply(qureg, lambda: qt.applyGateMatrixN(qureg, list(targets), m),
+                     targets, m)
+
+
+@pytest.mark.parametrize("ctrls,targets", [((1,), (0,)), ((0, 2), (3, 4)), ((4,), (1, 2))])
+def test_applyMultiControlledMatrixN(qureg, ctrls, targets):
+    t = len(targets)
+    m = RNG.randn(1 << t, 1 << t) + 1j * RNG.randn(1 << t, 1 << t)
+    check_left_apply(
+        qureg,
+        lambda: qt.applyMultiControlledMatrixN(qureg, list(ctrls), list(targets), m),
+        targets, m, ctrls)
+
+
+@pytest.mark.parametrize("ctrls,targets", [((1,), (0,)), ((0, 2), (3, 4))])
+def test_applyMultiControlledGateMatrixN(qureg, ctrls, targets):
+    t = len(targets)
+    m = RNG.randn(1 << t, 1 << t) + 1j * RNG.randn(1 << t, 1 << t)
+    check_gate_apply(
+        qureg,
+        lambda: qt.applyMultiControlledGateMatrixN(qureg, list(ctrls), list(targets), m),
+        targets, m, ctrls)
+
+
+def test_applyMatrix_validation(statevec):
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.applyMatrix2(statevec, NUM_QUBITS, np.eye(2))
+    with pytest.raises(qt.QuESTError):
+        qt.applyMatrixN(statevec, [0, 1], np.eye(2))  # wrong matrix size
+    with pytest.raises(qt.QuESTError, match="unique"):
+        qt.applyMatrix4(statevec, 1, 1, np.eye(4))
+
+
+# ---------------------------------------------------------------------------
+# Pauli sums / Hamiltonians / Trotter
+# ---------------------------------------------------------------------------
+
+def _pauli_sum_matrix(codes, coeffs):
+    acc = np.zeros((DIM, DIM), dtype=np.complex128)
+    for t in range(len(coeffs)):
+        acc += coeffs[t] * oracle.pauli_product_matrix(
+            NUM_QUBITS, range(NUM_QUBITS), codes[t])
+    return acc
+
+
+def test_applyPauliSum(qureg):
+    codes = [[1, 0, 0, 0, 0], [0, 2, 3, 0, 0], [3, 3, 0, 1, 2]]
+    coeffs = [0.3, -1.1, 0.5]
+    H = _pauli_sum_matrix(codes, coeffs)
+    ref = debug_state_and_ref(qureg)
+    if qureg.is_density_matrix:
+        out = qt.createDensityQureg(NUM_QUBITS, ENV)
+    else:
+        out = qt.createQureg(NUM_QUBITS, ENV)
+    qt.applyPauliSum(qureg, np.ravel(codes), coeffs, out)
+    if qureg.is_density_matrix:
+        assert_density_equal(out, H @ ref)
+        assert_density_equal(qureg, ref)  # in-qureg restored
+    else:
+        assert_statevec_equal(out, H @ ref)
+        assert_statevec_equal(qureg, ref)
+    qt.destroyQureg(out, ENV)
+
+
+def test_applyPauliHamil(statevec):
+    hamil = qt.createPauliHamil(NUM_QUBITS, 2)
+    qt.initPauliHamil(hamil, [0.7, -0.2], [[1, 1, 0, 0, 3], [0, 2, 0, 2, 0]])
+    H = _pauli_sum_matrix(hamil.pauli_codes, hamil.term_coeffs)
+    ref = debug_state_and_ref(statevec)
+    out = qt.createQureg(NUM_QUBITS, ENV)
+    qt.applyPauliHamil(statevec, hamil, out)
+    assert_statevec_equal(out, H @ ref)
+    qt.destroyQureg(out, ENV)
+
+
+def _term_exponential(code_row, coeff, dt):
+    """e^{-i c dt P}: cos(c dt) I - i sin(c dt) P (P != I), else phase."""
+    P = oracle.pauli_product_matrix(NUM_QUBITS, range(NUM_QUBITS), code_row)
+    if np.allclose(P, np.eye(DIM)):
+        return np.exp(-1j * coeff * dt) * np.eye(DIM)
+    return math.cos(coeff * dt) * np.eye(DIM) - 1j * math.sin(coeff * dt) * P
+
+
+@pytest.mark.parametrize("order,reps", [(1, 1), (1, 3), (2, 1), (2, 2), (4, 1)])
+def test_applyTrotterCircuit(statevec, order, reps):
+    hamil = qt.createPauliHamil(NUM_QUBITS, 3)
+    codes = [[1, 0, 0, 0, 0], [3, 3, 0, 0, 0], [0, 0, 2, 1, 0]]
+    coeffs = [0.5, -0.3, 0.8]
+    qt.initPauliHamil(hamil, coeffs, codes)
+    time = 0.6
+    ref = debug_state_and_ref(statevec)
+    qt.applyTrotterCircuit(statevec, hamil, time, order, reps)
+
+    # oracle: replicate the symmetric Suzuki recursion with exact term
+    # exponentials (distinct from the gate-level multiRotatePauli path)
+    def first_order(state, dt, reverse):
+        idx = range(len(coeffs))
+        for t in (reversed(list(idx)) if reverse else idx):
+            state = _term_exponential(codes[t], coeffs[t], dt) @ state
+        return state
+
+    def cycle(state, dt, order):
+        if order == 1:
+            return first_order(state, dt, False)
+        if order == 2:
+            return first_order(first_order(state, dt / 2, False), dt / 2, True)
+        p = 1.0 / (4 - 4 ** (1.0 / (order - 1)))
+        for frac in (p, p, 1 - 4 * p, p, p):
+            state = cycle(state, frac * dt, order - 2)
+        return state
+
+    for _ in range(reps):
+        ref = cycle(ref, time / reps, order)
+    assert_statevec_equal(statevec, ref, tol=1e-8)
+
+
+def test_applyTrotterCircuit_converges(statevec):
+    """Higher order/reps approach the exact evolution e^{-iHt}."""
+    hamil = qt.createPauliHamil(NUM_QUBITS, 2)
+    codes = [[1, 0, 0, 0, 0], [3, 1, 0, 0, 0]]
+    coeffs = [0.5, 0.31]
+    qt.initPauliHamil(hamil, coeffs, codes)
+    H = _pauli_sum_matrix(codes, coeffs)
+    w, v = np.linalg.eigh(H)
+    t = 0.4
+    exact = v @ np.diag(np.exp(-1j * w * t)) @ v.conj().T
+    qt.initPlusState(statevec)
+    ref = exact @ (np.ones(DIM) / math.sqrt(DIM))
+    qt.applyTrotterCircuit(statevec, hamil, t, 2, 20)
+    assert np.abs(get_statevec(statevec) - ref).max() < 1e-3
+
+
+def test_setQuregToPauliHamil(density):
+    hamil = qt.createPauliHamil(NUM_QUBITS, 2)
+    codes = [[1, 0, 3, 0, 0], [0, 2, 0, 0, 1]]
+    coeffs = [0.25, -1.5]
+    qt.initPauliHamil(hamil, coeffs, codes)
+    qt.setQuregToPauliHamil(density, hamil)
+    assert_density_equal(density, _pauli_sum_matrix(codes, coeffs))
+
+
+# ---------------------------------------------------------------------------
+# QFT
+# ---------------------------------------------------------------------------
+
+def _dft_matrix(m):
+    dim = 1 << m
+    x = np.arange(dim)
+    return np.exp(2j * np.pi * np.outer(x, x) / dim) / math.sqrt(dim)
+
+
+def test_applyFullQFT(qureg):
+    ref = debug_state_and_ref(qureg)
+    qt.applyFullQFT(qureg)
+    F = _dft_matrix(NUM_QUBITS)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, F @ ref @ F.conj().T)
+    else:
+        assert_statevec_equal(qureg, F @ ref)
+
+
+@pytest.mark.parametrize("qubits", [(0,), (2, 1), (0, 2, 4), (3, 1, 0, 2)])
+def test_applyQFT(statevec, qubits):
+    ref = debug_state_and_ref(statevec)
+    qt.applyQFT(statevec, list(qubits))
+    # oracle: DFT over the sub-register value, with qubits[0] least significant
+    F = oracle.full_operator(NUM_QUBITS, qubits, _dft_matrix(len(qubits)))
+    assert_statevec_equal(statevec, F @ ref)
+
+
+def test_applyQFT_validation(statevec):
+    with pytest.raises(qt.QuESTError, match="unique"):
+        qt.applyQFT(statevec, [1, 1])
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.applyQFT(statevec, [NUM_QUBITS])
+
+
+# ---------------------------------------------------------------------------
+# projector
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("target", range(NUM_QUBITS))
+@pytest.mark.parametrize("outcome", [0, 1])
+def test_applyProjector(qureg, target, outcome):
+    P = np.zeros((2, 2), dtype=complex)
+    P[outcome, outcome] = 1.0
+    ref = debug_state_and_ref(qureg)
+    qt.applyProjector(qureg, target, outcome)
+    F = oracle.full_operator(NUM_QUBITS, (target,), P)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, F @ ref @ F.conj().T)
+    else:
+        assert_statevec_equal(qureg, F @ ref)
+
+
+def test_applyProjector_validation(statevec):
+    with pytest.raises(qt.QuESTError):
+        qt.applyProjector(statevec, 0, 2)
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.applyProjector(statevec, -1, 0)
+
+
+# ---------------------------------------------------------------------------
+# DiagonalOp family
+# ---------------------------------------------------------------------------
+
+def _random_diag():
+    return RNG.randn(DIM), RNG.randn(DIM)
+
+
+def test_applyDiagonalOp(qureg):
+    re, im = _random_diag()
+    op = qt.createDiagonalOp(NUM_QUBITS, ENV)
+    qt.initDiagonalOp(op, re, im)
+    d = re + 1j * im
+    ref = debug_state_and_ref(qureg)
+    qt.applyDiagonalOp(qureg, op)
+    if qureg.is_density_matrix:
+        # reference: D rho (left mult only, no conj shadow) - QuEST.h:1282
+        assert_density_equal(qureg, np.diag(d) @ ref)
+    else:
+        assert_statevec_equal(qureg, d * ref)
+    qt.destroyDiagonalOp(op, ENV)
+
+
+def test_setDiagonalOpElems(statevec):
+    op = qt.createDiagonalOp(NUM_QUBITS, ENV)
+    re, im = _random_diag()
+    qt.initDiagonalOp(op, re, im)
+    sub_re = np.array([9.0, 8.0, 7.0])
+    sub_im = np.array([-1.0, -2.0, -3.0])
+    qt.setDiagonalOpElems(op, 4, sub_re, sub_im, 3)
+    d = re + 1j * im
+    d[4:7] = sub_re + 1j * sub_im
+    ref = debug_state_and_ref(statevec)
+    qt.applyDiagonalOp(statevec, op)
+    assert_statevec_equal(statevec, d * ref)
+    with pytest.raises(qt.QuESTError):
+        qt.setDiagonalOpElems(op, DIM - 1, sub_re, sub_im, 3)
+    qt.destroyDiagonalOp(op, ENV)
+
+
+def test_initDiagonalOpFromPauliHamil(statevec):
+    hamil = qt.createPauliHamil(NUM_QUBITS, 3)
+    codes = [[3, 0, 0, 0, 0], [3, 3, 0, 0, 3], [0, 0, 0, 0, 0]]
+    coeffs = [0.5, -1.2, 0.9]
+    qt.initPauliHamil(hamil, coeffs, codes)
+    op = qt.createDiagonalOp(NUM_QUBITS, ENV)
+    qt.initDiagonalOpFromPauliHamil(op, hamil)
+    d = np.diag(_pauli_sum_matrix(codes, coeffs))
+    ref = debug_state_and_ref(statevec)
+    qt.applyDiagonalOp(statevec, op)
+    assert_statevec_equal(statevec, d * ref)
+    # non-IZ terms rejected
+    bad = qt.createPauliHamil(NUM_QUBITS, 1)
+    qt.initPauliHamil(bad, [1.0], [[1, 0, 0, 0, 0]])
+    with pytest.raises(qt.QuESTError, match="PAULI_Z"):
+        qt.initDiagonalOpFromPauliHamil(op, bad)
+    qt.destroyDiagonalOp(op, ENV)
+
+
+def test_createDiagonalOpFromPauliHamilFile(tmp_path, statevec):
+    path = tmp_path / "hamil.txt"
+    path.write_text("0.5 3 0 0 0 0\n-1.25 3 3 0 0 0\n")
+    op = qt.createDiagonalOpFromPauliHamilFile(str(path), ENV)
+    codes = [[3, 0, 0, 0, 0], [3, 3, 0, 0, 0]]
+    d = np.diag(_pauli_sum_matrix(codes, [0.5, -1.25]))
+    ref = debug_state_and_ref(statevec)
+    qt.applyDiagonalOp(statevec, op)
+    assert_statevec_equal(statevec, d * ref)
+    qt.destroyDiagonalOp(op, ENV)
+
+
+def test_calcExpecDiagonalOp_density(density):
+    re, im = _random_diag()
+    op = qt.createDiagonalOp(NUM_QUBITS, ENV)
+    qt.initDiagonalOp(op, re, im)
+    rho = debug_state_and_ref(density)
+    got = qt.calcExpecDiagonalOp(density, op)
+    ref = np.trace(np.diag(re + 1j * im) @ rho)
+    assert got == pytest.approx(ref, abs=1e-9)
+    qt.destroyDiagonalOp(op, ENV)
+
+
+@pytest.mark.parametrize("targets", [(0,), (1, 3), (4, 0)])
+def test_applySubDiagonalOp(qureg, targets):
+    t = len(targets)
+    op = qt.createSubDiagonalOp(t)
+    elems = RNG.randn(1 << t) + 1j * RNG.randn(1 << t)
+    op.elems[...] = elems
+    ref = debug_state_and_ref(qureg)
+    qt.applySubDiagonalOp(qureg, list(targets), op)
+    F = oracle.full_operator(NUM_QUBITS, targets, np.diag(elems))
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, F @ ref)  # left mult only
+    else:
+        assert_statevec_equal(qureg, F @ ref)
+
+
+@pytest.mark.parametrize("targets", [(0,), (2, 4)])
+def test_applyGateSubDiagonalOp(qureg, targets):
+    t = len(targets)
+    op = qt.createSubDiagonalOp(t)
+    elems = np.exp(1j * RNG.randn(1 << t))
+    op.elems[...] = elems
+    ref = debug_state_and_ref(qureg)
+    qt.applyGateSubDiagonalOp(qureg, list(targets), op)
+    F = oracle.full_operator(NUM_QUBITS, targets, np.diag(elems))
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, F @ ref @ F.conj().T)
+    else:
+        assert_statevec_equal(qureg, F @ ref)
+
+
+# ---------------------------------------------------------------------------
+# phase functions: scalar-loop oracle
+# ---------------------------------------------------------------------------
+
+def _reg_values(i, qubit_regs, encoding):
+    """Per-register encoded sub-register values of amplitude index i."""
+    vals = []
+    for reg in qubit_regs:
+        m = len(reg)
+        v = 0
+        for j, q in enumerate(reg):
+            bit = (i >> q) & 1
+            if encoding == bitEncoding.TWOS_COMPLEMENT and j == m - 1:
+                v -= bit << (m - 1)
+            else:
+                v += bit << j
+        vals.append(v)
+    return vals
+
+
+def _phase_oracle_poly(n, qubit_regs, encoding, coeffs, exponents, terms_per_reg,
+                       ovr_inds, ovr_phases):
+    """Phase vector over all 2^n indices for the polynomial family."""
+    num_regs = len(qubit_regs)
+    phases = np.zeros(1 << n)
+    for i in range(1 << n):
+        vals = _reg_values(i, qubit_regs, encoding)
+        phase = None
+        for o in range(len(ovr_phases)):
+            if all(vals[r] == ovr_inds[o * num_regs + r] for r in range(num_regs)):
+                phase = ovr_phases[o]
+                break
+        if phase is None:
+            phase = 0.0
+            flat = 0
+            for r in range(num_regs):
+                for _t in range(terms_per_reg[r]):
+                    phase += coeffs[flat] * float(vals[r]) ** exponents[flat]
+                    flat += 1
+        phases[i] = phase
+    return phases
+
+
+def _apply_phases_ref(state, phases, is_density):
+    if is_density:
+        f = np.exp(1j * phases)
+        return np.diag(f) @ state @ np.diag(f).conj().T
+    return np.exp(1j * phases) * state
+
+
+@pytest.mark.parametrize("encoding", [bitEncoding.UNSIGNED, bitEncoding.TWOS_COMPLEMENT])
+@pytest.mark.parametrize("qubits", [(0, 1, 2), (4, 2, 0)])
+def test_applyPhaseFunc(qureg, encoding, qubits):
+    coeffs = [0.3, -0.7]
+    exponents = [1.0, 2.0]
+    ref = debug_state_and_ref(qureg)
+    qt.applyPhaseFunc(qureg, list(qubits), encoding, coeffs, exponents)
+    phases = _phase_oracle_poly(NUM_QUBITS, [qubits], encoding, coeffs,
+                                exponents, [2], [], [])
+    ref = _apply_phases_ref(ref, phases, qureg.is_density_matrix)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, ref)
+    else:
+        assert_statevec_equal(qureg, ref)
+
+
+def test_applyPhaseFunc_negative_base(statevec):
+    """TWOS_COMPLEMENT with fractional exponent on negative values is the
+    documented invalid case; integer exponents must work."""
+    ref = debug_state_and_ref(statevec)
+    qubits = (0, 1)
+    qt.applyPhaseFunc(statevec, list(qubits), bitEncoding.TWOS_COMPLEMENT,
+                      [0.5], [3.0])
+    phases = _phase_oracle_poly(NUM_QUBITS, [qubits], 1, [0.5], [3.0], [1], [], [])
+    assert_statevec_equal(statevec, np.exp(1j * phases) * ref)
+
+
+@pytest.mark.parametrize("encoding", [bitEncoding.UNSIGNED, bitEncoding.TWOS_COMPLEMENT])
+def test_applyPhaseFuncOverrides(qureg, encoding):
+    qubits = (1, 3, 0)
+    coeffs = [1.1]
+    exponents = [2.0]
+    ovr_inds = [0, 2]  # override sub-register values 0 and 2
+    ovr_phases = [0.25, -0.5]
+    ref = debug_state_and_ref(qureg)
+    qt.applyPhaseFuncOverrides(qureg, list(qubits), encoding, coeffs, exponents,
+                               ovr_inds, ovr_phases)
+    phases = _phase_oracle_poly(NUM_QUBITS, [qubits], encoding, coeffs,
+                                exponents, [1], ovr_inds, ovr_phases)
+    ref = _apply_phases_ref(ref, phases, qureg.is_density_matrix)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, ref)
+    else:
+        assert_statevec_equal(qureg, ref)
+
+
+def test_applyMultiVarPhaseFunc(statevec):
+    regs = [(0, 1), (2, 3, 4)]
+    coeffs = [0.5, -0.2, 0.9]
+    exponents = [1.0, 2.0, 1.0]
+    terms_per_reg = [2, 1]
+    ref = debug_state_and_ref(statevec)
+    qt.applyMultiVarPhaseFunc(statevec, [0, 1, 2, 3, 4], [2, 3],
+                              bitEncoding.UNSIGNED, coeffs, exponents, terms_per_reg)
+    phases = _phase_oracle_poly(NUM_QUBITS, regs, 0, coeffs, exponents,
+                                terms_per_reg, [], [])
+    assert_statevec_equal(statevec, np.exp(1j * phases) * ref)
+
+
+def test_applyMultiVarPhaseFuncOverrides(qureg):
+    regs = [(3, 1), (0, 4)]
+    coeffs = [0.4, 1.3]
+    exponents = [2.0, 1.0]
+    terms_per_reg = [1, 1]
+    ovr_inds = [1, 2, 0, 0]  # (r0=1,r1=2) and (r0=0,r1=0)
+    ovr_phases = [3.14, -1.0]
+    ref = debug_state_and_ref(qureg)
+    qt.applyMultiVarPhaseFuncOverrides(qureg, [3, 1, 0, 4], [2, 2],
+                                       bitEncoding.UNSIGNED, coeffs, exponents,
+                                       terms_per_reg, ovr_inds, ovr_phases)
+    phases = _phase_oracle_poly(NUM_QUBITS, regs, 0, coeffs, exponents,
+                                terms_per_reg, ovr_inds, ovr_phases)
+    ref = _apply_phases_ref(ref, phases, qureg.is_density_matrix)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, ref)
+    else:
+        assert_statevec_equal(qureg, ref)
+
+
+def _phase_oracle_named(n, qubit_regs, encoding, fn, params, ovr_inds, ovr_phases,
+                        eps=1e-13):
+    """Scalar-loop oracle replicating QuEST_cpu.c:4440-4530 semantics."""
+    P = phaseFunc
+    num_regs = len(qubit_regs)
+    par = list(params) + [0.0] * 16
+    phases = np.zeros(1 << n)
+    for i in range(1 << n):
+        vals = _reg_values(i, qubit_regs, encoding)
+        phase = None
+        for o in range(len(ovr_phases)):
+            if all(vals[r] == ovr_inds[o * num_regs + r] for r in range(num_regs)):
+                phase = ovr_phases[o]
+                break
+        if phase is None:
+            if fn in (P.NORM, P.INVERSE_NORM, P.SCALED_NORM, P.SCALED_INVERSE_NORM,
+                      P.SCALED_INVERSE_SHIFTED_NORM):
+                if fn == P.SCALED_INVERSE_SHIFTED_NORM:
+                    norm = math.sqrt(sum((vals[r] - par[2 + r]) ** 2
+                                         for r in range(num_regs)))
+                else:
+                    norm = math.sqrt(sum(v * v for v in vals))
+                if fn == P.NORM:
+                    phase = norm
+                elif fn == P.INVERSE_NORM:
+                    phase = par[0] if norm == 0 else 1 / norm
+                elif fn == P.SCALED_NORM:
+                    phase = par[0] * norm
+                else:
+                    phase = par[1] if norm <= eps else par[0] / norm
+            elif fn in (P.PRODUCT, P.INVERSE_PRODUCT, P.SCALED_PRODUCT,
+                        P.SCALED_INVERSE_PRODUCT):
+                prod = 1.0
+                for v in vals:
+                    prod *= v
+                if fn == P.PRODUCT:
+                    phase = prod
+                elif fn == P.INVERSE_PRODUCT:
+                    phase = par[0] if prod == 0 else 1 / prod
+                elif fn == P.SCALED_PRODUCT:
+                    phase = par[0] * prod
+                else:
+                    phase = par[1] if prod == 0 else par[0] / prod
+            else:
+                dist = 0.0
+                if fn == P.SCALED_INVERSE_SHIFTED_DISTANCE:
+                    for r in range(0, num_regs, 2):
+                        dist += (vals[r] - vals[r + 1] - par[2 + r // 2]) ** 2
+                elif fn == P.SCALED_INVERSE_SHIFTED_WEIGHTED_DISTANCE:
+                    for r in range(0, num_regs, 2):
+                        dist += par[2 + r] * (vals[r] - vals[r + 1] - par[2 + r + 1]) ** 2
+                else:
+                    for r in range(0, num_regs, 2):
+                        dist += (vals[r + 1] - vals[r]) ** 2
+                dist = math.sqrt(max(dist, 0.0))
+                if fn == P.DISTANCE:
+                    phase = dist
+                elif fn == P.INVERSE_DISTANCE:
+                    phase = par[0] if dist == 0 else 1 / dist
+                elif fn == P.SCALED_DISTANCE:
+                    phase = par[0] * dist
+                else:
+                    phase = par[1] if dist <= eps else par[0] / dist
+        phases[i] = phase
+    return phases
+
+
+NAMED_CASES = [
+    (phaseFunc.NORM, []),
+    (phaseFunc.SCALED_NORM, [2.5]),
+    (phaseFunc.INVERSE_NORM, [7.0]),
+    (phaseFunc.SCALED_INVERSE_NORM, [1.5, -3.0]),
+    (phaseFunc.SCALED_INVERSE_SHIFTED_NORM, [1.5, -3.0, 0.5, 1.0]),
+    (phaseFunc.PRODUCT, []),
+    (phaseFunc.SCALED_PRODUCT, [-1.2]),
+    (phaseFunc.INVERSE_PRODUCT, [4.0]),
+    (phaseFunc.SCALED_INVERSE_PRODUCT, [2.0, 0.7]),
+    (phaseFunc.DISTANCE, []),
+    (phaseFunc.SCALED_DISTANCE, [0.8]),
+    (phaseFunc.INVERSE_DISTANCE, [5.0]),
+    (phaseFunc.SCALED_INVERSE_DISTANCE, [1.0, 2.0]),
+    (phaseFunc.SCALED_INVERSE_SHIFTED_DISTANCE, [1.0, 2.0, 1.5]),
+    (phaseFunc.SCALED_INVERSE_SHIFTED_WEIGHTED_DISTANCE, [1.0, 2.0, 0.5, 1.0]),
+]
+
+
+@pytest.mark.parametrize("fn,params", NAMED_CASES)
+def test_applyParamNamedPhaseFunc(statevec, fn, params):
+    regs = [(0, 1), (2, 3)]
+    ref = debug_state_and_ref(statevec)
+    qt.applyParamNamedPhaseFunc(statevec, [0, 1, 2, 3], [2, 2],
+                                bitEncoding.UNSIGNED, fn, params)
+    phases = _phase_oracle_named(NUM_QUBITS, regs, 0, fn, params, [], [])
+    assert_statevec_equal(statevec, np.exp(1j * phases) * ref)
+
+
+def test_applyNamedPhaseFunc(qureg):
+    regs = [(0, 2), (1, 4)]
+    ref = debug_state_and_ref(qureg)
+    qt.applyNamedPhaseFunc(qureg, [0, 2, 1, 4], [2, 2],
+                           bitEncoding.UNSIGNED, phaseFunc.NORM)
+    phases = _phase_oracle_named(NUM_QUBITS, regs, 0, phaseFunc.NORM, [], [], [])
+    ref = _apply_phases_ref(ref, phases, qureg.is_density_matrix)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, ref)
+    else:
+        assert_statevec_equal(qureg, ref)
+
+
+def test_applyNamedPhaseFuncOverrides(statevec):
+    regs = [(0, 1), (2, 3)]
+    ovr_inds = [0, 0, 1, 2]
+    ovr_phases = [0.123, 4.56]
+    ref = debug_state_and_ref(statevec)
+    qt.applyNamedPhaseFuncOverrides(statevec, [0, 1, 2, 3], [2, 2],
+                                    bitEncoding.UNSIGNED, phaseFunc.PRODUCT,
+                                    ovr_inds, ovr_phases)
+    phases = _phase_oracle_named(NUM_QUBITS, regs, 0, phaseFunc.PRODUCT, [],
+                                 ovr_inds, ovr_phases)
+    assert_statevec_equal(statevec, np.exp(1j * phases) * ref)
+
+
+def test_applyParamNamedPhaseFuncOverrides(qureg):
+    regs = [(4, 0), (3, 2)]
+    fn = phaseFunc.SCALED_INVERSE_NORM
+    params = [3.0, -0.5]
+    ovr_inds = [0, 0]
+    ovr_phases = [1.0]
+    ref = debug_state_and_ref(qureg)
+    qt.applyParamNamedPhaseFuncOverrides(qureg, [4, 0, 3, 2], [2, 2],
+                                         bitEncoding.TWOS_COMPLEMENT, fn, params,
+                                         ovr_inds, ovr_phases)
+    phases = _phase_oracle_named(NUM_QUBITS, regs, 1, fn, params,
+                                 ovr_inds, ovr_phases)
+    ref = _apply_phases_ref(ref, phases, qureg.is_density_matrix)
+    if qureg.is_density_matrix:
+        assert_density_equal(qureg, ref)
+    else:
+        assert_statevec_equal(qureg, ref)
+
+
+def test_phaseFunc_validation(statevec):
+    with pytest.raises(qt.QuESTError):
+        qt.applyPhaseFunc(statevec, [0, 1], bitEncoding.UNSIGNED, [], [])
+    with pytest.raises(qt.QuESTError, match="DISTANCE"):
+        qt.applyNamedPhaseFunc(statevec, [0, 1, 2], [3], bitEncoding.UNSIGNED,
+                               phaseFunc.DISTANCE)
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.applyPhaseFunc(statevec, [0, NUM_QUBITS], bitEncoding.UNSIGNED,
+                          [1.0], [1.0])
