@@ -113,8 +113,21 @@ def initComplexMatrixN(matrix: np.ndarray, real, imag) -> None:
     matrix[...] = np.asarray(real) + 1j * np.asarray(imag)
 
 
-def getStaticComplexMatrixN(real, imag) -> np.ndarray:
-    """Build a matrix from nested lists (reference macro getStaticComplexMatrixN)."""
+def getStaticComplexMatrixN(real, imag=None, _imag=None) -> np.ndarray:
+    """Build a matrix from nested lists (reference macro getStaticComplexMatrixN,
+    QuEST.h:6232). Accepts both the 2-arg (re, im) and the reference's 3-arg
+    (numQubits, re, im) call shapes."""
+    func = "getStaticComplexMatrixN"
+    if np.ndim(real) == 0:  # 3-arg reference shape: (numQubits, re, im)
+        num_qubits, real, imag = int(real), imag, _imag
+        validation._assert(imag is not None,
+                           "Both real and imaginary matrix components must be given.", func)
+        m = np.asarray(real) + 1j * np.asarray(imag)
+        validation._assert(m.shape == (1 << num_qubits, 1 << num_qubits),
+                           "Invalid matrix dimensions for the given number of qubits.", func)
+        return m
+    validation._assert(_imag is None and imag is not None,
+                       "Both real and imaginary matrix components must be given.", func)
     return np.asarray(real) + 1j * np.asarray(imag)
 
 

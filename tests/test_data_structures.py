@@ -1,0 +1,317 @@
+"""Data-structure creation/access correctness + validation.
+
+Mirrors the reference's tests/test_data_structures.cpp (25 cases): Qureg and
+env lifecycle, ComplexMatrixN, PauliHamil (incl. file parsing), DiagonalOp,
+SubDiagonalOp, and the amp getters/setters.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+from . import oracle
+from .helpers import (NUM_QUBITS, assert_density_equal, assert_statevec_equal,
+                      get_density, get_statevec)
+
+ENV = qt.createQuESTEnv()
+DIM = 1 << NUM_QUBITS
+
+
+# ---------------------------------------------------------------------------
+# env
+# ---------------------------------------------------------------------------
+
+def test_createQuESTEnv():
+    env = qt.createQuESTEnv()
+    assert env.num_ranks >= 1 and env.num_ranks & (env.num_ranks - 1) == 0
+    assert env.rank == 0
+    qt.syncQuESTEnv(env)
+    assert qt.syncQuESTSuccess(1) == 1
+    qt.destroyQuESTEnv(env)
+
+
+def test_environment_string():
+    s = qt.getEnvironmentString(ENV)
+    assert "TPU=1" in s and f"ranks={ENV.num_ranks}" in s
+
+
+def test_seeding():
+    env = qt.createQuESTEnv()
+    qt.seedQuEST(env, [11, 22, 33])
+    assert qt.getQuESTSeeds(env) == [11, 22, 33]
+    # same seeds -> same measurement stream
+    q1 = qt.createQureg(3, env)
+    qt.initPlusState(q1)
+    outcomes1 = [qt.measure(q1, 0) for _ in range(5)]
+    qt.seedQuEST(env, [11, 22, 33])
+    q2 = qt.createQureg(3, env)
+    qt.initPlusState(q2)
+    outcomes2 = [qt.measure(q2, 0) for _ in range(5)]
+    assert outcomes1 == outcomes2
+
+
+# ---------------------------------------------------------------------------
+# Qureg lifecycle
+# ---------------------------------------------------------------------------
+
+def test_createQureg():
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    assert not q.is_density_matrix
+    assert q.num_qubits_represented == NUM_QUBITS
+    assert q.num_amps_total == DIM
+    vec = get_statevec(q)
+    ref = np.zeros(DIM, dtype=complex)
+    ref[0] = 1.0
+    assert np.allclose(vec, ref)
+    with pytest.raises(qt.QuESTError, match="Invalid number of qubits"):
+        qt.createQureg(0, ENV)
+    with pytest.raises(qt.QuESTError, match="Invalid number of qubits"):
+        qt.createQureg(-1, ENV)
+    qt.destroyQureg(q, ENV)
+
+
+def test_createDensityQureg():
+    q = qt.createDensityQureg(NUM_QUBITS, ENV)
+    assert q.is_density_matrix
+    assert q.num_amps_total == DIM * DIM
+    rho = get_density(q)
+    ref = np.zeros((DIM, DIM), dtype=complex)
+    ref[0, 0] = 1.0
+    assert np.allclose(rho, ref)
+    with pytest.raises(qt.QuESTError):
+        qt.createDensityQureg(0, ENV)
+    qt.destroyQureg(q, ENV)
+
+
+def test_createCloneQureg():
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    qt.initDebugState(q)
+    c = qt.createCloneQureg(q, ENV)
+    assert_statevec_equal(c, oracle.debug_statevec(DIM))
+    # independent: mutating the clone leaves the source alone
+    qt.pauliX(c, 0)
+    assert_statevec_equal(q, oracle.debug_statevec(DIM))
+    qt.destroyQureg(c, ENV)
+    qt.destroyQureg(q, ENV)
+
+
+def test_reportQuregParams(capsys):
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    qt.reportQuregParams(q)
+    out = capsys.readouterr().out
+    assert str(NUM_QUBITS) in out and str(DIM) in out
+    qt.destroyQureg(q, ENV)
+
+
+# ---------------------------------------------------------------------------
+# ComplexMatrixN
+# ---------------------------------------------------------------------------
+
+def test_createComplexMatrixN():
+    m = qt.createComplexMatrixN(3)
+    assert m.shape == (8, 8)
+    assert np.allclose(np.asarray(m), np.zeros((8, 8)))
+    with pytest.raises(qt.QuESTError):
+        qt.createComplexMatrixN(0)
+    qt.destroyComplexMatrixN(m)
+
+
+def test_initComplexMatrixN():
+    m = qt.createComplexMatrixN(2)
+    re = np.arange(16.0).reshape(4, 4)
+    im = -np.arange(16.0).reshape(4, 4)
+    qt.initComplexMatrixN(m, re, im)
+    assert np.allclose(np.asarray(m), re + 1j * im)
+    qt.destroyComplexMatrixN(m)
+
+
+def test_getStaticComplexMatrixN():
+    m = qt.getStaticComplexMatrixN(1, [[1, 2], [3, 4]], [[0, 0], [0, 0]])
+    assert np.allclose(np.asarray(m), [[1, 2], [3, 4]])
+
+
+def test_complexMatrixN_as_gate():
+    """A ComplexMatrixN is accepted wherever a raw ndarray is."""
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    qt.initDebugState(q)
+    u = oracle.random_unitary(2, np.random.RandomState(5))
+    m = qt.createComplexMatrixN(2)
+    qt.initComplexMatrixN(m, u.real, u.imag)
+    qt.multiQubitUnitary(q, [1, 3], m)
+    ref = oracle.apply_to_statevec(oracle.debug_statevec(DIM), NUM_QUBITS, (1, 3), u)
+    assert_statevec_equal(q, ref)
+    qt.destroyQureg(q, ENV)
+
+
+# ---------------------------------------------------------------------------
+# PauliHamil
+# ---------------------------------------------------------------------------
+
+def test_createPauliHamil():
+    h = qt.createPauliHamil(4, 3)
+    assert h.num_qubits == 4 and h.num_sum_terms == 3
+    assert h.pauli_codes.shape == (3, 4)
+    assert np.all(h.pauli_codes == 0) and np.all(h.term_coeffs == 0)
+    with pytest.raises(qt.QuESTError):
+        qt.createPauliHamil(0, 1)
+    with pytest.raises(qt.QuESTError):
+        qt.createPauliHamil(1, 0)
+    qt.destroyPauliHamil(h)
+
+
+def test_initPauliHamil():
+    h = qt.createPauliHamil(2, 2)
+    qt.initPauliHamil(h, [0.5, -1.0], [[1, 3], [0, 2]])
+    assert np.allclose(h.term_coeffs, [0.5, -1.0])
+    assert np.all(h.pauli_codes == [[1, 3], [0, 2]])
+    with pytest.raises(qt.QuESTError, match="Invalid Pauli code"):
+        qt.initPauliHamil(h, [1.0, 1.0], [[4, 0], [0, 0]])
+    qt.destroyPauliHamil(h)
+
+
+def test_createPauliHamilFromFile(tmp_path):
+    path = tmp_path / "h.txt"
+    path.write_text("0.25 1 0 2\n-0.75 3 3 0\n1.5 0 0 0\n")
+    h = qt.createPauliHamilFromFile(str(path))
+    assert h.num_qubits == 3 and h.num_sum_terms == 3
+    assert np.allclose(h.term_coeffs, [0.25, -0.75, 1.5])
+    assert np.all(h.pauli_codes == [[1, 0, 2], [3, 3, 0], [0, 0, 0]])
+
+
+def test_createPauliHamilFromFile_invalid(tmp_path):
+    path = tmp_path / "bad.txt"
+    path.write_text("0.5 1 0\n0.5 7 0\n")
+    with pytest.raises(qt.QuESTError):
+        qt.createPauliHamilFromFile(str(path))
+
+
+def test_reportPauliHamil(capsys):
+    h = qt.createPauliHamil(2, 1)
+    qt.initPauliHamil(h, [0.5], [[1, 3]])
+    qt.reportPauliHamil(h)
+    out = capsys.readouterr().out
+    assert "0.5" in out
+
+
+# ---------------------------------------------------------------------------
+# DiagonalOp / SubDiagonalOp lifecycle (application tested in test_operators)
+# ---------------------------------------------------------------------------
+
+def test_createDiagonalOp():
+    op = qt.createDiagonalOp(NUM_QUBITS, ENV)
+    assert op.num_qubits == NUM_QUBITS
+    assert op.elems.shape == (2, DIM)
+    qt.syncDiagonalOp(op)  # no-op, must not raise
+    with pytest.raises(qt.QuESTError):
+        qt.createDiagonalOp(0, ENV)
+    qt.destroyDiagonalOp(op, ENV)
+
+
+def test_createSubDiagonalOp():
+    op = qt.createSubDiagonalOp(2)
+    assert op.num_qubits == 2 and op.elems.shape == (4,)
+    with pytest.raises(qt.QuESTError):
+        qt.createSubDiagonalOp(0)
+    qt.destroySubDiagonalOp(op)
+
+
+# ---------------------------------------------------------------------------
+# amp getters / setters
+# ---------------------------------------------------------------------------
+
+def test_getAmp_family():
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    qt.initDebugState(q)
+    ref = oracle.debug_statevec(DIM)
+    for i in (0, 1, 7, DIM - 1):
+        assert qt.getAmp(q, i) == pytest.approx(ref[i])
+        assert qt.getRealAmp(q, i) == pytest.approx(ref[i].real)
+        assert qt.getImagAmp(q, i) == pytest.approx(ref[i].imag)
+        assert qt.getProbAmp(q, i) == pytest.approx(abs(ref[i]) ** 2)
+    assert qt.getNumAmps(q) == DIM
+    assert qt.getNumQubits(q) == NUM_QUBITS
+    with pytest.raises(qt.QuESTError):
+        qt.getAmp(q, DIM)
+    with pytest.raises(qt.QuESTError):
+        qt.getAmp(q, -1)
+    qt.destroyQureg(q, ENV)
+
+
+def test_getDensityAmp():
+    q = qt.createDensityQureg(3, ENV)
+    qt.initDebugState(q)
+    rho = oracle.debug_statevec(64).reshape(8, 8).T
+    for r, c in [(0, 0), (1, 5), (7, 7), (3, 2)]:
+        assert qt.getDensityAmp(q, r, c) == pytest.approx(rho[r, c])
+    with pytest.raises(qt.QuESTError):
+        qt.getDensityAmp(q, 8, 0)
+    qt.destroyQureg(q, ENV)
+
+
+def test_setAmps():
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    qt.initDebugState(q)
+    ref = oracle.debug_statevec(DIM)
+    re = np.array([5.0, 6.0, 7.0])
+    im = np.array([-5.0, -6.0, -7.0])
+    qt.setAmps(q, 2, re, im, 3)
+    ref[2:5] = re + 1j * im
+    assert_statevec_equal(q, ref)
+    with pytest.raises(qt.QuESTError):
+        qt.setAmps(q, DIM - 1, re, im, 3)
+    qt.destroyQureg(q, ENV)
+
+
+def test_setDensityAmps():
+    q = qt.createDensityQureg(3, ENV)
+    qt.initDebugState(q)
+    rho = oracle.debug_statevec(64).reshape(8, 8).T
+    re = np.array([1.0, 2.0])
+    im = np.array([3.0, 4.0])
+    qt.setDensityAmps(q, 1, 5, re, im, 2)
+    # column-major order from (row=1, col=5)
+    rho[1, 5] = 1 + 3j
+    rho[2, 5] = 2 + 4j
+    assert_density_equal(q, rho)
+    qt.destroyQureg(q, ENV)
+
+
+def test_initStateFromAmps_roundtrip():
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    rng = np.random.RandomState(3)
+    vec = oracle.random_statevec(NUM_QUBITS, rng)
+    qt.initStateFromAmps(q, vec.real, vec.imag)
+    assert_statevec_equal(q, vec)
+    qt.destroyQureg(q, ENV)
+
+
+def test_setWeightedQureg():
+    q1 = qt.createQureg(NUM_QUBITS, ENV)
+    q2 = qt.createQureg(NUM_QUBITS, ENV)
+    out = qt.createQureg(NUM_QUBITS, ENV)
+    rng = np.random.RandomState(4)
+    v1 = oracle.random_statevec(NUM_QUBITS, rng)
+    v2 = oracle.random_statevec(NUM_QUBITS, rng)
+    qt.initStateFromAmps(q1, v1.real, v1.imag)
+    qt.initStateFromAmps(q2, v2.real, v2.imag)
+    f1, f2, fout = 0.3 - 0.1j, 1.2 + 0.5j, -0.7j
+    vout = oracle.debug_statevec(DIM)
+    qt.initStateFromAmps(out, vout.real, vout.imag)
+    qt.setWeightedQureg(f1, q1, f2, q2, fout, out)
+    assert_statevec_equal(out, f1 * v1 + f2 * v2 + fout * vout)
+    for q in (q1, q2, out):
+        qt.destroyQureg(q, ENV)
+
+
+def test_cloneQureg():
+    src = qt.createQureg(NUM_QUBITS, ENV)
+    dst = qt.createQureg(NUM_QUBITS, ENV)
+    qt.initDebugState(src)
+    qt.cloneQureg(dst, src)
+    assert_statevec_equal(dst, oracle.debug_statevec(DIM))
+    small = qt.createQureg(NUM_QUBITS - 1, ENV)
+    with pytest.raises(qt.QuESTError):
+        qt.cloneQureg(small, src)
+    for q in (src, dst, small):
+        qt.destroyQureg(q, ENV)

@@ -1,0 +1,142 @@
+"""Measurement / collapse correctness (reference tests/test_gates.cpp:
+measure, measureWithStats, collapseToOutcome).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+from . import oracle
+from .helpers import (NUM_QUBITS, assert_density_equal, assert_statevec_equal,
+                      debug_state_and_ref, set_density, set_statevec)
+
+ENV = qt.createQuESTEnv()
+DIM = 1 << NUM_QUBITS
+
+
+@pytest.fixture(params=["statevec", "density"])
+def qureg(request):
+    if request.param == "statevec":
+        q = qt.createQureg(NUM_QUBITS, ENV)
+    else:
+        q = qt.createDensityQureg(NUM_QUBITS, ENV)
+    yield q
+    qt.destroyQureg(q, ENV)
+
+
+def _collapsed_vec(vec, target, outcome):
+    mask = ((np.arange(DIM) >> target) & 1) == outcome
+    prob = np.sum(np.abs(vec[mask]) ** 2)
+    out = np.where(mask, vec, 0) / math.sqrt(prob)
+    return out, prob
+
+
+def _collapsed_rho(rho, target, outcome):
+    P = np.zeros((2, 2))
+    P[outcome, outcome] = 1.0
+    F = oracle.full_operator(NUM_QUBITS, (target,), P)
+    proj = F @ rho @ F
+    prob = np.real(np.trace(proj))
+    return proj / prob, prob
+
+
+@pytest.mark.parametrize("target", range(NUM_QUBITS))
+@pytest.mark.parametrize("outcome", [0, 1])
+def test_collapseToOutcome(qureg, target, outcome):
+    rng = np.random.RandomState(target * 2 + outcome)
+    if qureg.is_density_matrix:
+        rho = oracle.random_density(NUM_QUBITS, rng)
+        set_density(qureg, rho)
+        ref, prob = _collapsed_rho(rho, target, outcome)
+        got = qt.collapseToOutcome(qureg, target, outcome)
+        assert got == pytest.approx(prob, abs=1e-10)
+        assert_density_equal(qureg, ref)
+    else:
+        vec = oracle.random_statevec(NUM_QUBITS, rng)
+        set_statevec(qureg, vec)
+        ref, prob = _collapsed_vec(vec, target, outcome)
+        got = qt.collapseToOutcome(qureg, target, outcome)
+        assert got == pytest.approx(prob, abs=1e-10)
+        assert_statevec_equal(qureg, ref)
+
+
+def test_collapseToOutcome_impossible(qureg):
+    """Collapsing onto a zero-probability outcome is invalid
+    (validateMeasurementProb)."""
+    if qureg.is_density_matrix:
+        qt.initClassicalState(qureg, 0)
+    else:
+        qt.initZeroState(qureg)
+    with pytest.raises(qt.QuESTError):
+        qt.collapseToOutcome(qureg, 0, 1)
+
+
+def test_collapseToOutcome_validation(qureg):
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.collapseToOutcome(qureg, NUM_QUBITS, 0)
+    with pytest.raises(qt.QuESTError):
+        qt.collapseToOutcome(qureg, 0, 3)
+
+
+def test_measure_deterministic_outcomes(qureg):
+    """A classical state always measures to its bit values."""
+    index = 0b10110 & (DIM - 1)
+    qt.initClassicalState(qureg, index)
+    for target in range(NUM_QUBITS):
+        assert qt.measure(qureg, target) == ((index >> target) & 1)
+
+
+def test_measureWithStats(qureg):
+    qt.initPlusState(qureg)
+    outcome, prob = qt.measureWithStats(qureg, 2)
+    assert outcome in (0, 1)
+    assert prob == pytest.approx(0.5, abs=1e-6)
+    # state collapsed: re-measuring the same qubit gives the same outcome
+    for _ in range(3):
+        o2, p2 = qt.measureWithStats(qureg, 2)
+        assert o2 == outcome
+        assert p2 == pytest.approx(1.0, abs=1e-6)
+
+
+def test_measure_statistics():
+    """Seeded measurement outcomes follow the amplitude distribution
+    (the reference checks a uniform-ish empirical distribution)."""
+    env = qt.createQuESTEnv()
+    qt.seedQuEST(env, [1234])
+    theta = 1.2
+    p1 = math.sin(theta / 2) ** 2
+    ones = 0
+    trials = 300
+    q = qt.createQureg(2, env)
+    for _ in range(trials):
+        qt.initZeroState(q)
+        qt.rotateX(q, 0, theta)
+        ones += qt.measure(q, 0)
+    # 4-sigma band around the binomial mean
+    sigma = math.sqrt(trials * p1 * (1 - p1))
+    assert abs(ones - trials * p1) < 4 * sigma
+    qt.destroyQureg(q, env)
+
+
+def test_measure_collapses_state(qureg):
+    ref = debug_state_and_ref(qureg)
+    # normalise the debug state first so probabilities are meaningful
+    if qureg.is_density_matrix:
+        tr = np.real(np.trace(ref))
+        ref = ref / tr
+        set_density(qureg, ref)
+    else:
+        ref = ref / np.linalg.norm(ref)
+        set_statevec(qureg, ref)
+    outcome, prob = qt.measureWithStats(qureg, 1)
+    if qureg.is_density_matrix:
+        exp_rho, exp_prob = _collapsed_rho(ref, 1, outcome)
+        assert prob == pytest.approx(exp_prob, abs=1e-9)
+        assert_density_equal(qureg, exp_rho, tol=1e-8)
+    else:
+        exp_vec, exp_prob = _collapsed_vec(ref, 1, outcome)
+        assert prob == pytest.approx(exp_prob, abs=1e-9)
+        assert_statevec_equal(qureg, exp_vec, tol=1e-8)
