@@ -24,7 +24,8 @@ Architecture map (reference -> here):
 
 from .datatypes import (  # noqa: F401
     PAULI_I, PAULI_X, PAULI_Y, PAULI_Z,
-    DiagonalOp, PauliHamil, SubDiagonalOp, Vector, bitEncoding,
+    DiagonalOp, PauliHamil, SubDiagonalOp, Vector,
+    bindArraysToStackComplexMatrixN, bitEncoding,
     createComplexMatrixN, createPauliHamil, createPauliHamilFromFile,
     createSubDiagonalOp, destroyComplexMatrixN, destroyPauliHamil,
     destroySubDiagonalOp, getStaticComplexMatrixN, initComplexMatrixN,
@@ -36,11 +37,13 @@ from .environment import (  # noqa: F401
     syncQuESTSuccess,
 )
 from .registers import (  # noqa: F401
-    Qureg, createCloneQureg, createDensityQureg, createQureg, destroyQureg,
-    get_np,
+    Qureg, copyStateFromGPU, copyStateToGPU, copySubstateFromGPU,
+    copySubstateToGPU, createCloneQureg, createDensityQureg, createQureg,
+    destroyQureg, get_np,
 )
 from .validation import (  # noqa: F401
-    QuESTError, invalid_quest_input_error, set_input_error_handler,
+    QuESTError, invalidQuESTInputError, invalid_quest_input_error,
+    set_input_error_handler,
 )
 from .circuits import Circuit  # noqa: F401
 from .parallel.scheduler import explicit_mesh, plan_circuit  # noqa: F401

@@ -113,6 +113,51 @@ def initComplexMatrixN(matrix: np.ndarray, real, imag) -> None:
     matrix[...] = np.asarray(real) + 1j * np.asarray(imag)
 
 
+class BoundComplexMatrixN:
+    """A ComplexMatrixN aliasing caller-owned real/imag storage
+    (bindArraysToStackComplexMatrixN, QuEST.h:6232, QuEST_common.c:649-677).
+
+    The reference points a stack matrix at user row arrays without copying,
+    so later edits to the storage are seen by subsequent gate applications.
+    Here the bound numpy planes are kept by reference and the complex matrix
+    is assembled lazily on each use (every consumer funnels through
+    ``np.asarray``, which calls ``__array__``).
+    """
+
+    def __init__(self, real: np.ndarray, imag: np.ndarray):
+        self.real = real
+        self.imag = imag
+        self.shape = real.shape
+        self.ndim = 2
+
+    def __array__(self, dtype=None, copy=None):
+        m = self.real + 1j * self.imag
+        return m.astype(dtype) if dtype is not None else m
+
+    def __getitem__(self, idx):
+        return (self.real + 1j * self.imag)[idx]
+
+    def __repr__(self):
+        return f"BoundComplexMatrixN({self.real + 1j * self.imag!r})"
+
+
+def bindArraysToStackComplexMatrixN(num_qubits: int, real, imag,
+                                    re_storage=None, im_storage=None) -> BoundComplexMatrixN:
+    """Bind a 2^n x 2^n matrix over caller-provided planar arrays without
+    copying; see :class:`BoundComplexMatrixN`. The ``re_storage``/
+    ``im_storage`` pointer-plumbing arguments are accepted for signature
+    parity and ignored (numpy arrays own their storage).
+    """
+    func = "bindArraysToStackComplexMatrixN"
+    dim = 1 << num_qubits
+    real = np.asarray(real, dtype=float)
+    imag = np.asarray(imag, dtype=float)
+    validation._assert(real.shape == (dim, dim) and imag.shape == (dim, dim),
+                       "Invalid matrix dimensions. The real and imaginary components must each be 2^numQubits x 2^numQubits.",
+                       func)
+    return BoundComplexMatrixN(real, imag)
+
+
 def getStaticComplexMatrixN(real, imag=None, _imag=None) -> np.ndarray:
     """Build a matrix from nested lists (reference macro getStaticComplexMatrixN,
     QuEST.h:6232). Accepts both the 2-arg (re, im) and the reference's 3-arg

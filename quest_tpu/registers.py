@@ -41,6 +41,14 @@ class Qureg:
     amps: jax.Array
     env: QuESTEnv
     qasm_log: Optional[QASMLogger] = None
+    #: lazily-created host planar mirror for copyState{To,From}GPU
+    host_amps: Optional[np.ndarray] = None
+
+    @property
+    def state_vec(self) -> np.ndarray:
+        """Host planar mirror (the reference's ``qureg.stateVec``); sync with
+        copyStateFromGPU/copyStateToGPU."""
+        return _host_mirror(self)
 
     @property
     def num_qubits_in_state_vec(self) -> int:
@@ -130,3 +138,69 @@ def get_np(qureg: Qureg) -> np.ndarray:
     (tests / reporting)."""
     from .ops import cplx
     return cplx.to_complex(qureg.amps)
+
+
+# --------------------------------------------------------------------------
+# Host-mirror synchronisation (copyStateToGPU/FromGPU, QuEST.h:2286-2383).
+#
+# The reference keeps a host planar array (qureg.stateVec) beside the device
+# copy and lets users edit it directly, syncing explicitly. Here the device
+# jax.Array is the state of record; ``qureg.state_vec`` is a lazily-created
+# planar numpy mirror (shape (2, numAmps): real plane, imag plane) that these
+# four functions sync in either direction. On CPU backends they still work --
+# they are then just host<->host copies, matching the reference's no-op CPU
+# definitions (QuEST_cpu_local.c) while keeping the mirror coherent.
+# --------------------------------------------------------------------------
+
+def _host_mirror(qureg: Qureg) -> np.ndarray:
+    if getattr(qureg, "host_amps", None) is None:
+        qureg.host_amps = np.zeros((2, qureg.num_amps_total),
+                                   dtype=qureg.amps.dtype)
+    return qureg.host_amps
+
+
+def _validate_live(qureg: Qureg, func: str) -> None:
+    validation._assert(
+        qureg.amps is not None,
+        "Invalid Qureg. The register has been destroyed.", func)
+
+
+def copyStateFromGPU(qureg: Qureg) -> np.ndarray:
+    """Pull the device state into the host mirror (copyStateFromGPU, QuEST.h:2321)."""
+    _validate_live(qureg, "copyStateFromGPU")
+    mirror = _host_mirror(qureg)
+    mirror[...] = np.asarray(qureg.amps)
+    return mirror
+
+
+def copyStateToGPU(qureg: Qureg) -> None:
+    """Push the host mirror to the device (copyStateToGPU, QuEST.h:2301)."""
+    _validate_live(qureg, "copyStateToGPU")
+    mirror = _host_mirror(qureg)
+    new = jax.device_put(jnp.asarray(mirror), qureg.amps.sharding)
+    qureg.put(new)
+
+
+def copySubstateFromGPU(qureg: Qureg, start_ind: int, num_amps: int) -> np.ndarray:
+    """Pull amplitudes [start, start+num) into the host mirror
+    (copySubstateFromGPU, QuEST.h:2383)."""
+    func = "copySubstateFromGPU"
+    _validate_live(qureg, func)
+    validation.validate_num_amps(qureg, start_ind, num_amps, func)
+    mirror = _host_mirror(qureg)
+    chunk = jax.lax.dynamic_slice_in_dim(qureg.amps, start_ind, num_amps, axis=1)
+    mirror[:, start_ind:start_ind + num_amps] = np.asarray(chunk)
+    return mirror
+
+
+def copySubstateToGPU(qureg: Qureg, start_ind: int, num_amps: int) -> None:
+    """Push host-mirror amplitudes [start, start+num) to the device
+    (copySubstateToGPU, QuEST.h:2352)."""
+    func = "copySubstateToGPU"
+    _validate_live(qureg, func)
+    validation.validate_num_amps(qureg, start_ind, num_amps, func)
+    mirror = _host_mirror(qureg)
+    patch = jnp.asarray(mirror[:, start_ind:start_ind + num_amps])
+    new = jax.lax.dynamic_update_slice_in_dim(qureg.amps, patch, start_ind, axis=1)
+    new = jax.device_put(new, qureg.amps.sharding)
+    qureg.put(new)

@@ -40,6 +40,16 @@ def _default_handler(err_msg: str, err_func: str) -> None:
 invalid_quest_input_error: Callable[[str, str], None] = _default_handler
 
 
+def invalidQuESTInputError(errMsg: str, errFunc: str) -> None:
+    """Reference-named error hook (invalidQuESTInputError, QuEST.h:6160-6188).
+
+    Dispatches through the current module-level handler so that
+    :func:`set_input_error_handler` overrides it exactly as redefining the
+    C symbol overrides the reference's weak default.
+    """
+    invalid_quest_input_error(errMsg, errFunc)
+
+
 def set_input_error_handler(handler: Callable[[str, str], None] | None) -> None:
     """Override the validation failure hook (None restores the default)."""
     global invalid_quest_input_error
@@ -47,7 +57,11 @@ def set_input_error_handler(handler: Callable[[str, str], None] | None) -> None:
 
 
 def _fail(msg: str, func: str) -> None:
-    invalid_quest_input_error(msg, func)
+    # dispatch through the reference-named symbol so BOTH override styles
+    # work: set_input_error_handler(...) and rebinding
+    # quest_tpu.validation.invalidQuESTInputError (the tests/main.cpp:27-29
+    # redefinition trick)
+    invalidQuESTInputError(msg, func)
     # If a user hook returns instead of raising, we still must not continue
     # with invalid inputs (the reference documents returning as UB); raise.
     raise QuESTError(msg, func)
