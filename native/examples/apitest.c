@@ -1,0 +1,182 @@
+/* Native smoke-test for the quest_tpu C API.
+ *
+ * Exercises one representative of each API family end-to-end (registers,
+ * gates, matrices, Pauli Hamiltonians, diagonal ops, decoherence,
+ * calculations, QASM, validation via an overridden error hook) and exits
+ * non-zero on any mismatch. The Python test suite runs this binary; it is
+ * the native analogue of the reference's tests/tests executable.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "QuEST.h"
+
+static int failures = 0;
+static int expectedErrors = 0;
+
+#define CHECK(cond, what) do { \
+    if (!(cond)) { printf("FAIL: %s\n", what); failures++; } \
+    else { printf("ok: %s\n", what); } \
+} while (0)
+
+#define NEAR(a, b, what) CHECK(fabs((a) - (b)) < 1e-5, what)
+
+/* Non-weak override of the validation hook: count and continue.
+ * Mirrors the reference test-suite's redefinition (tests/main.cpp). */
+void invalidQuESTInputError(const char *errMsg, const char *errFunc) {
+    printf("caught expected error in %s: %s\n", errFunc, errMsg);
+    expectedErrors++;
+}
+
+int main(void) {
+    QuESTEnv env = createQuESTEnv();
+    char envStr[200];
+    getEnvironmentString(env, envStr);
+    CHECK(strstr(envStr, "TPU=1") != NULL, "environment string");
+
+    /* --- state-vector basics -------------------------------------------- */
+    Qureg q = createQureg(4, env);
+    CHECK(getNumQubits(q) == 4 && getNumAmps(q) == 16, "qureg dims");
+
+    hadamard(q, 0);
+    controlledNot(q, 0, 1);
+    NEAR(calcTotalProb(q), 1.0, "bell total prob");
+    NEAR(calcProbOfOutcome(q, 1, 1), 0.5, "bell P(q1=1)");
+
+    Complex amp = getAmp(q, 3);
+    NEAR(amp.real, 1.0 / sqrt(2.0), "bell amp[3]");
+
+    /* amp write + read-back through the device */
+    qreal res[16] = {0}, ims[16] = {0};
+    res[5] = 1.0;
+    initStateFromAmps(q, res, ims);
+    NEAR(getProbAmp(q, 5), 1.0, "initStateFromAmps");
+    qreal re2 = 0.6, im2 = 0.8;
+    setAmps(q, 5, (qreal[]) {0.6}, (qreal[]) {0.8}, 1);
+    NEAR(getRealAmp(q, 5), re2, "setAmps real");
+    NEAR(getImagAmp(q, 5), im2, "setAmps imag");
+
+    /* host mirror sync */
+    copyStateFromGPU(q);
+    NEAR(q.stateVec.real[5], 0.6, "copyStateFromGPU mirror");
+    q.stateVec.real[5] = 0.0;
+    q.stateVec.imag[5] = 0.0;
+    q.stateVec.real[0] = 1.0;
+    copyStateToGPU(q);
+    NEAR(getProbAmp(q, 0), 1.0, "copyStateToGPU");
+
+    /* --- multi-qubit matrices ------------------------------------------- */
+    ComplexMatrixN xx = createComplexMatrixN(2);
+    /* X (x) X: anti-diagonal ones, contiguous row-major init */
+    qreal xxRe[4][4] = {{0, 0, 0, 1}, {0, 0, 1, 0}, {0, 1, 0, 0}, {1, 0, 0, 0}};
+    qreal xxIm[4][4] = {{0}};
+    initComplexMatrixN(xx, xxRe, xxIm);
+    initZeroState(q);
+    multiQubitUnitary(q, (int[]) {0, 1}, 2, xx);
+    NEAR(getProbAmp(q, 3), 1.0, "multiQubitUnitary X(x)X");
+    destroyComplexMatrixN(xx);
+
+    ComplexMatrixN stackX = getStaticComplexMatrixN(1, ({{0, 1}, {1, 0}}), ({{0, 0}, {0, 0}}));
+    applyGateMatrixN(q, (int[]) {2}, 1, stackX);
+    NEAR(getProbAmp(q, 7), 1.0, "getStaticComplexMatrixN X");
+
+    /* --- QFT + phase functions ------------------------------------------ */
+    initZeroState(q);
+    applyFullQFT(q);
+    NEAR(getProbAmp(q, 0), 1.0 / 16.0, "applyFullQFT uniform");
+    applyPhaseFunc(q, (int[]) {0, 1}, 2, UNSIGNED,
+                   (qreal[]) {1.0}, (qreal[]) {2.0}, 1);
+    NEAR(calcTotalProb(q), 1.0, "applyPhaseFunc norm");
+
+    /* --- Pauli Hamiltonian ---------------------------------------------- */
+    PauliHamil h = createPauliHamil(4, 2);
+    /* 0.7 * Z0 + 0.3 * X1 */
+    qreal coeffs[2] = {0.7, 0.3};
+    enum pauliOpType codes[8] = {
+        PAULI_Z, PAULI_I, PAULI_I, PAULI_I,
+        PAULI_I, PAULI_X, PAULI_I, PAULI_I,
+    };
+    initPauliHamil(h, coeffs, codes);
+    initZeroState(q);
+    Qureg work = createQureg(4, env);
+    NEAR(calcExpecPauliHamil(q, h, work), 0.7, "calcExpecPauliHamil <0|H|0>");
+    destroyPauliHamil(h);
+
+    /* --- diagonal operators --------------------------------------------- */
+    DiagonalOp op = createDiagonalOp(4, env);
+    for (long long i = 0; i < 16; i++) {
+        op.real[i] = (qreal) i;
+        op.imag[i] = 0;
+    }
+    syncDiagonalOp(op);
+    initPlusState(q);
+    Complex ev = calcExpecDiagonalOp(q, op);
+    NEAR(ev.real, 7.5, "calcExpecDiagonalOp uniform mean");
+    destroyDiagonalOp(op, env);
+
+    SubDiagonalOp sub = createSubDiagonalOp(1);
+    sub.real[0] = 1;
+    sub.real[1] = -1; /* Z */
+    initZeroState(q);
+    pauliX(q, 0);
+    diagonalUnitary(q, (int[]) {0}, 1, sub);
+    NEAR(getRealAmp(q, 1), -1.0, "diagonalUnitary Z");
+    destroySubDiagonalOp(sub);
+
+    /* --- density matrices + decoherence --------------------------------- */
+    Qureg rho = createDensityQureg(2, env);
+    initPlusState(rho);
+    NEAR(calcPurity(rho), 1.0, "pure density purity");
+    mixDepolarising(rho, 0, 0.3);
+    NEAR(calcTotalProb(rho), 1.0, "depolarised trace");
+    CHECK(calcPurity(rho) < 1.0, "depolarised purity < 1");
+
+    ComplexMatrix2 k0 = {.real = {{1, 0}, {0, sqrt(0.5)}}, .imag = {{0}}};
+    ComplexMatrix2 k1 = {.real = {{0, sqrt(0.5)}, {0, 0}}, .imag = {{0}}};
+    ComplexMatrix2 kraus[2] = {k0, k1};
+    mixKrausMap(rho, 1, kraus, 2);
+    NEAR(calcTotalProb(rho), 1.0, "kraus trace preserved");
+
+    Qureg pure = createQureg(2, env);
+    initPlusState(pure);
+    qreal fid = calcFidelity(rho, pure);
+    CHECK(fid > 0.0 && fid < 1.0 + 1e-6, "fidelity in range");
+    destroyQureg(pure, env);
+    destroyQureg(rho, env);
+
+    /* --- measurement ----------------------------------------------------- */
+    initZeroState(q);
+    hadamard(q, 0);
+    qreal prob = collapseToOutcome(q, 0, 1);
+    NEAR(prob, 0.5, "collapse prob");
+    NEAR(calcProbOfOutcome(q, 0, 1), 1.0, "collapsed state");
+    int outcome = measure(q, 0);
+    CHECK(outcome == 1, "measure after collapse");
+
+    qreal allProbs[4];
+    initZeroState(q);
+    hadamard(q, 0);
+    calcProbOfAllOutcomes(allProbs, q, (int[]) {0, 1}, 2);
+    NEAR(allProbs[0], 0.5, "calcProbOfAllOutcomes[0]");
+    NEAR(allProbs[1], 0.5, "calcProbOfAllOutcomes[1]");
+    NEAR(allProbs[2], 0.0, "calcProbOfAllOutcomes[2]");
+
+    /* --- validation through the overridden hook -------------------------- */
+    int before = expectedErrors;
+    pauliX(q, 99);                    /* bad target */
+    controlledNot(q, 1, 1);           /* control == target */
+    CHECK(expectedErrors == before + 2, "validation errors routed to hook");
+
+    destroyQureg(work, env);
+    destroyQureg(q, env);
+    destroyQuESTEnv(env);
+
+    if (failures) {
+        printf("apitest: %d FAILURES\n", failures);
+        return 1;
+    }
+    printf("apitest: all checks passed\n");
+    return 0;
+}

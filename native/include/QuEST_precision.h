@@ -1,0 +1,23 @@
+/* quest_tpu native shim: numeric precision of the C ABI.
+ *
+ * Unlike the reference (QuEST/include/QuEST_precision.h), which bakes the
+ * register precision into the ABI at compile time, the TPU build decouples
+ * the two: the C ABI always speaks double (the reference's PRECISION=2
+ * default), while the on-device register precision is a runtime property of
+ * the JAX core (QUEST_PRECISION env var / per-register precision_code).
+ * REAL_EPS below is therefore the ABI-side tolerance; validation inside the
+ * core uses the register's own dtype epsilon.
+ */
+#ifndef QUEST_TPU_PRECISION_H
+#define QUEST_TPU_PRECISION_H
+
+typedef double qreal;
+
+#define QuEST_PREC 2
+#define REAL_EPS 1e-13
+#define REAL_SPECIFIER "%lf"
+#define REAL_QASM_SPECIFIER "%g"
+
+#define absReal(X) fabs(X)
+
+#endif /* QUEST_TPU_PRECISION_H */

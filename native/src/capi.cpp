@@ -1,0 +1,1230 @@
+/* quest_tpu native C API implementation.
+ *
+ * Implements every function declared in native/include/QuEST.h by embedding
+ * a CPython interpreter and dispatching into the quest_tpu JAX/XLA core via
+ * quest_tpu/capi_bridge.py. The C structs carry value-type mirror fields
+ * plus an integer handle into the bridge's object registry; bulk data
+ * (amplitudes, diagonals) crosses the boundary as raw float64 byte buffers.
+ *
+ * Reference architecture note: in QuEST the C layer IS the engine
+ * (QuEST.c -> QuEST_cpu.c/QuEST_gpu.cu). Here the engine is XLA; this file
+ * is the runtime veneer that gives reference C programs TPU execution.
+ */
+
+#include <Python.h>
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+extern "C" {
+#include "QuEST.h"
+}
+
+/* ------------------------------------------------------------ interpreter -- */
+
+static PyObject *gBridge = nullptr;
+
+static void fatalPy(const char *where) {
+    fprintf(stderr, "quest_tpu C API: unrecoverable Python error in %s\n", where);
+    if (PyErr_Occurred()) PyErr_Print();
+    exit(EXIT_FAILURE);
+}
+
+static void ensureInit(void) {
+    if (gBridge) return;
+    if (!Py_IsInitialized()) {
+        PyConfig config;
+        PyConfig_InitPythonConfig(&config);
+        config.buffered_stdio = 0;  /* interleave Python and C stdout */
+        PyStatus status = Py_InitializeFromConfig(&config);
+        PyConfig_Clear(&config);
+        if (PyStatus_Exception(status)) fatalPy("Py_InitializeFromConfig");
+    }
+    /* make quest_tpu importable: honour QUEST_TPU_PYTHONPATH, else cwd */
+    PyRun_SimpleString(
+        "import sys, os\n"
+        "for _p in (os.environ.get('QUEST_TPU_PYTHONPATH') or '').split(':')[::-1]:\n"
+        "    if _p and _p not in sys.path: sys.path.insert(0, _p)\n"
+        "if os.getcwd() not in sys.path: sys.path.insert(0, os.getcwd())\n");
+    gBridge = PyImport_ImportModule("quest_tpu.capi_bridge");
+    if (!gBridge) fatalPy("import quest_tpu.capi_bridge");
+}
+
+/* ------------------------------------------------------- error propagation -- */
+
+/* Default validation-failure hook; link your own non-weak definition to
+ * override, exactly as with the reference's weak symbol (QuEST.h:6160). */
+extern "C" void __attribute__((weak))
+invalidQuESTInputError(const char *errMsg, const char *errFunc) {
+    fprintf(stderr, "!!!\nQuEST Error in function %s: %s\n!!!\n", errFunc, errMsg);
+    exit(EXIT_FAILURE);
+}
+
+/* Translate a Python exception (QuESTError carries .message/.func) into the
+ * C error hook. If the user's hook returns (e.g. a test harness that throws
+ * a C++ exception instead, or longjmps), the Python error state is cleared
+ * first so the interpreter stays usable. */
+static void handleError(const char *cfunc) {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    PyErr_NormalizeException(&type, &value, &tb);
+    std::string msg = "unknown error", func = cfunc;
+    if (value) {
+        PyObject *m = PyObject_GetAttrString(value, "message");
+        PyObject *f = PyObject_GetAttrString(value, "func");
+        PyErr_Clear();
+        if (m && PyUnicode_Check(m)) {
+            msg = PyUnicode_AsUTF8(m);
+            if (f && PyUnicode_Check(f) && PyUnicode_GetLength(f) > 0)
+                func = PyUnicode_AsUTF8(f);
+        } else {
+            PyObject *s = PyObject_Str(value);
+            if (s) { msg = PyUnicode_AsUTF8(s); Py_DECREF(s); }
+        }
+        Py_XDECREF(m);
+        Py_XDECREF(f);
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+    invalidQuESTInputError(msg.c_str(), func.c_str());
+}
+
+/* ---------------------------------------------------------- call plumbing -- */
+
+/* Pack n PyObject* (refs stolen) into a tuple. */
+static PyObject *tup(int n, ...) {
+    PyObject *t = PyTuple_New(n);
+    va_list va;
+    va_start(va, n);
+    for (int i = 0; i < n; i++) PyTuple_SET_ITEM(t, i, va_arg(va, PyObject *));
+    va_end(va);
+    return t;
+}
+
+/* Call a bridge method with a Py_BuildValue-style arg tuple. */
+static PyObject *bcall(const char *method, const char *fmt, ...) {
+    ensureInit();
+    va_list va;
+    va_start(va, fmt);
+    PyObject *args = Py_VaBuildValue(fmt, va);
+    va_end(va);
+    if (!args) fatalPy(method);
+    if (!PyTuple_Check(args)) {
+        PyObject *t = PyTuple_Pack(1, args);
+        Py_DECREF(args);
+        args = t;
+    }
+    PyObject *fn = PyObject_GetAttrString(gBridge, method);
+    if (!fn) fatalPy(method);
+    PyObject *r = PyObject_CallObject(fn, args);
+    Py_DECREF(fn);
+    Py_DECREF(args);
+    if (!r) handleError(method);
+    return r;
+}
+
+/* Call a top-level quest_tpu function (bridge.call) with a stolen arg tuple. */
+static PyObject *apicall(const char *fname, PyObject *args /* stolen */) {
+    ensureInit();
+    Py_ssize_t n = PyTuple_GET_SIZE(args);
+    PyObject *full = PyTuple_New(n + 1);
+    PyTuple_SET_ITEM(full, 0, PyUnicode_FromString(fname));
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *it = PyTuple_GET_ITEM(args, i);
+        Py_INCREF(it);
+        PyTuple_SET_ITEM(full, i + 1, it);
+    }
+    Py_DECREF(args);
+    PyObject *fn = PyObject_GetAttrString(gBridge, "call");
+    PyObject *r = PyObject_CallObject(fn, full);
+    Py_DECREF(fn);
+    Py_DECREF(full);
+    if (!r) handleError(fname);
+    return r;
+}
+
+/* ------------------------------------------------------ result extractors -- */
+
+static void asVoid(PyObject *r) { Py_XDECREF(r); }
+
+static double asD(PyObject *r) {
+    if (!r) return 0;
+    double v = PyFloat_AsDouble(r);
+    Py_DECREF(r);
+    if (PyErr_Occurred()) fatalPy("float result");
+    return v;
+}
+
+static long long asLL(PyObject *r) {
+    if (!r) return 0;
+    long long v = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    if (PyErr_Occurred()) fatalPy("int result");
+    return v;
+}
+
+static int asI(PyObject *r) { return (int) asLL(r); }
+
+static Complex asC(PyObject *r) {
+    Complex c = {0, 0};
+    if (!r) return c;
+    Py_complex pc = PyComplex_AsCComplex(r);
+    Py_DECREF(r);
+    if (PyErr_Occurred()) fatalPy("complex result");
+    c.real = pc.real;
+    c.imag = pc.imag;
+    return c;
+}
+
+/* copy a (bytes, bytes) pair of float64 planes into C arrays */
+static void asPlanes(PyObject *r, qreal *re, qreal *im, long long n) {
+    if (!r) return;
+    char *b;
+    Py_ssize_t len;
+    PyBytes_AsStringAndSize(PyTuple_GetItem(r, 0), &b, &len);
+    memcpy(re, b, (size_t) (n * (long long) sizeof(qreal)) < (size_t) len ? n * sizeof(qreal) : (size_t) len);
+    PyBytes_AsStringAndSize(PyTuple_GetItem(r, 1), &b, &len);
+    memcpy(im, b, (size_t) (n * (long long) sizeof(qreal)) < (size_t) len ? n * sizeof(qreal) : (size_t) len);
+    Py_DECREF(r);
+}
+
+/* -------------------------------------------------------- arg marshalling -- */
+
+static PyObject *I(long long v) { return PyLong_FromLongLong(v); }
+static PyObject *D(double v) { return PyFloat_FromDouble(v); }
+static PyObject *S(const char *s) { return PyUnicode_FromString(s); }
+static PyObject *CPy(Complex c) { return PyComplex_FromDoubles(c.real, c.imag); }
+static PyObject *VPy(Vector v) { return Py_BuildValue("(ddd)", v.x, v.y, v.z); }
+
+static PyObject *IntList(const int *a, long long n) {
+    PyObject *l = PyList_New(n);
+    for (long long i = 0; i < n; i++) PyList_SET_ITEM(l, i, PyLong_FromLong(a[i]));
+    return l;
+}
+
+static PyObject *PauliList(const enum pauliOpType *a, long long n) {
+    PyObject *l = PyList_New(n);
+    for (long long i = 0; i < n; i++) PyList_SET_ITEM(l, i, PyLong_FromLong((long) a[i]));
+    return l;
+}
+
+static PyObject *LLList(const long long int *a, long long n) {
+    PyObject *l = PyList_New(n);
+    for (long long i = 0; i < n; i++) PyList_SET_ITEM(l, i, PyLong_FromLongLong(a[i]));
+    return l;
+}
+
+static PyObject *DList(const qreal *a, long long n) {
+    PyObject *l = PyList_New(n);
+    for (long long i = 0; i < n; i++) PyList_SET_ITEM(l, i, PyFloat_FromDouble(a[i]));
+    return l;
+}
+
+static PyObject *Bytes(const qreal *a, long long n) {
+    return PyBytes_FromStringAndSize((const char *) a, n * sizeof(qreal));
+}
+
+static PyObject *M2Py(ComplexMatrix2 u) {
+    PyObject *rows = PyList_New(2);
+    for (int i = 0; i < 2; i++) {
+        PyObject *row = PyList_New(2);
+        for (int j = 0; j < 2; j++)
+            PyList_SET_ITEM(row, j, PyComplex_FromDoubles(u.real[i][j], u.imag[i][j]));
+        PyList_SET_ITEM(rows, i, row);
+    }
+    return rows;
+}
+
+static PyObject *M4Py(ComplexMatrix4 u) {
+    PyObject *rows = PyList_New(4);
+    for (int i = 0; i < 4; i++) {
+        PyObject *row = PyList_New(4);
+        for (int j = 0; j < 4; j++)
+            PyList_SET_ITEM(row, j, PyComplex_FromDoubles(u.real[i][j], u.imag[i][j]));
+        PyList_SET_ITEM(rows, i, row);
+    }
+    return rows;
+}
+
+static PyObject *MNPy(ComplexMatrixN u) {
+    long long dim = 1LL << u.numQubits;
+    PyObject *rows = PyList_New(dim);
+    for (long long i = 0; i < dim; i++) {
+        PyObject *row = PyList_New(dim);
+        for (long long j = 0; j < dim; j++)
+            PyList_SET_ITEM(row, j, PyComplex_FromDoubles(u.real[i][j], u.imag[i][j]));
+        PyList_SET_ITEM(rows, i, row);
+    }
+    return rows;
+}
+
+static PyObject *M2ListPy(ComplexMatrix2 *ops, int n) {
+    PyObject *l = PyList_New(n);
+    for (int i = 0; i < n; i++) PyList_SET_ITEM(l, i, M2Py(ops[i]));
+    return l;
+}
+
+static PyObject *M4ListPy(ComplexMatrix4 *ops, int n) {
+    PyObject *l = PyList_New(n);
+    for (int i = 0; i < n; i++) PyList_SET_ITEM(l, i, M4Py(ops[i]));
+    return l;
+}
+
+static PyObject *MNListPy(ComplexMatrixN *ops, int n) {
+    PyObject *l = PyList_New(n);
+    for (int i = 0; i < n; i++) PyList_SET_ITEM(l, i, MNPy(ops[i]));
+    return l;
+}
+
+/* handle -> live core object */
+static PyObject *REF(int handle) { return bcall("ref", "(i)", handle); }
+static PyObject *QOBJ(Qureg q) { return REF(q._handle); }
+static PyObject *EOBJ(QuESTEnv e) { return REF(e._handle); }
+static PyObject *DOBJ(DiagonalOp o) { return REF(o._handle); }
+
+static PyObject *SDPy(SubDiagonalOp op) {
+    return bcall("make_subdiag", "(iNN)", op.numQubits,
+                 Bytes(op.real, op.numElems), Bytes(op.imag, op.numElems));
+}
+
+static PyObject *PHPy(PauliHamil h) {
+    return bcall("make_hamil", "(iNN)", h.numQubits,
+                 PauliList(h.pauliCodes, (long long) h.numSumTerms * h.numQubits),
+                 DList(h.termCoeffs, h.numSumTerms));
+}
+
+/* =========================================================== environment == */
+
+extern "C" QuESTEnv createQuESTEnv(void) {
+    ensureInit();
+    QuESTEnv env;
+    memset(&env, 0, sizeof(env));
+    PyObject *r = bcall("env_create", "()");
+    if (!r) return env;
+    env._handle = (int) PyLong_AsLong(PyTuple_GetItem(r, 0));
+    env.rank = (int) PyLong_AsLong(PyTuple_GetItem(r, 1));
+    env.numRanks = (int) PyLong_AsLong(PyTuple_GetItem(r, 2));
+    PyObject *seeds = PyTuple_GetItem(r, 3);
+    env.numSeeds = (int) PyList_Size(seeds);
+    env.seeds = (unsigned long int *) malloc(env.numSeeds * sizeof(unsigned long int));
+    for (int i = 0; i < env.numSeeds; i++)
+        env.seeds[i] = PyLong_AsUnsignedLongMask(PyList_GetItem(seeds, i));
+    Py_DECREF(r);
+    return env;
+}
+
+extern "C" void destroyQuESTEnv(QuESTEnv env) {
+    asVoid(bcall("env_destroy", "(i)", env._handle));
+    free(env.seeds);
+}
+
+extern "C" void syncQuESTEnv(QuESTEnv env) {
+    asVoid(apicall("syncQuESTEnv", tup(1, EOBJ(env))));
+}
+
+extern "C" int syncQuESTSuccess(int successCode) {
+    return asI(apicall("syncQuESTSuccess", tup(1, I(successCode))));
+}
+
+extern "C" void reportQuESTEnv(QuESTEnv env) {
+    asVoid(apicall("reportQuESTEnv", tup(1, EOBJ(env))));
+}
+
+extern "C" void getEnvironmentString(QuESTEnv env, char str[200]) {
+    PyObject *r = apicall("getEnvironmentString", tup(1, EOBJ(env)));
+    if (!r) return;
+    strncpy(str, PyUnicode_AsUTF8(r), 199);
+    str[199] = '\0';
+    Py_DECREF(r);
+}
+
+static void replaceSeeds(QuESTEnv *env, PyObject *r) {
+    if (!r) return;
+    free(env->seeds);
+    env->numSeeds = (int) PyList_Size(r);
+    env->seeds = (unsigned long int *) malloc(env->numSeeds * sizeof(unsigned long int));
+    for (int i = 0; i < env->numSeeds; i++)
+        env->seeds[i] = PyLong_AsUnsignedLongMask(PyList_GetItem(r, i));
+    Py_DECREF(r);
+}
+
+extern "C" void seedQuESTDefault(QuESTEnv *env) {
+    replaceSeeds(env, bcall("env_seed_default", "(i)", env->_handle));
+}
+
+extern "C" void seedQuEST(QuESTEnv *env, unsigned long int *seedArray, int numSeeds) {
+    PyObject *l = PyList_New(numSeeds);
+    for (int i = 0; i < numSeeds; i++)
+        PyList_SET_ITEM(l, i, PyLong_FromUnsignedLong(seedArray[i]));
+    replaceSeeds(env, bcall("env_seed", "(iN)", env->_handle, l));
+}
+
+extern "C" void getQuESTSeeds(QuESTEnv env, unsigned long int **seeds, int *numSeeds) {
+    *seeds = env.seeds;
+    *numSeeds = env.numSeeds;
+}
+
+/* ============================================================== registers == */
+
+static Qureg buildQureg(PyObject *r) {
+    Qureg q;
+    memset(&q, 0, sizeof(q));
+    q._handle = -1;
+    if (!r) return q;
+    q._handle = (int) PyLong_AsLong(PyTuple_GetItem(r, 0));
+    q.numQubitsInStateVec = (int) PyLong_AsLong(PyTuple_GetItem(r, 1));
+    q.numAmpsTotal = PyLong_AsLongLong(PyTuple_GetItem(r, 2));
+    Py_DECREF(r);
+    /* the C view is global: XLA owns the device-mesh partition internally */
+    q.numChunks = 1;
+    q.chunkId = 0;
+    q.numAmpsPerChunk = q.numAmpsTotal;
+    q.stateVec.real = (qreal *) calloc(q.numAmpsTotal, sizeof(qreal));
+    q.stateVec.imag = (qreal *) calloc(q.numAmpsTotal, sizeof(qreal));
+    q.pairStateVec.real = nullptr;
+    q.pairStateVec.imag = nullptr;
+    return q;
+}
+
+extern "C" Qureg createQureg(int numQubits, QuESTEnv env) {
+    ensureInit();
+    Qureg q = buildQureg(bcall("qureg_create", "(iii)", numQubits, env._handle, 0));
+    q.isDensityMatrix = 0;
+    q.numQubitsRepresented = numQubits;
+    return q;
+}
+
+extern "C" Qureg createDensityQureg(int numQubits, QuESTEnv env) {
+    ensureInit();
+    Qureg q = buildQureg(bcall("qureg_create", "(iii)", numQubits, env._handle, 1));
+    q.isDensityMatrix = 1;
+    q.numQubitsRepresented = numQubits;
+    return q;
+}
+
+extern "C" Qureg createCloneQureg(Qureg src, QuESTEnv env) {
+    Qureg q = buildQureg(bcall("qureg_clone", "(ii)", src._handle, env._handle));
+    q.isDensityMatrix = src.isDensityMatrix;
+    q.numQubitsRepresented = src.numQubitsRepresented;
+    return q;
+}
+
+extern "C" void destroyQureg(Qureg q, QuESTEnv env) {
+    (void) env;
+    asVoid(bcall("qureg_destroy", "(i)", q._handle));
+    free(q.stateVec.real);
+    free(q.stateVec.imag);
+}
+
+extern "C" int getNumQubits(Qureg q) { return q.numQubitsRepresented; }
+extern "C" long long int getNumAmps(Qureg q) { return q.numAmpsTotal; }
+
+extern "C" void copyStateFromGPU(Qureg q) {
+    asPlanes(bcall("qureg_pull", "(iLL)", q._handle, 0LL, q.numAmpsTotal),
+             q.stateVec.real, q.stateVec.imag, q.numAmpsTotal);
+}
+
+extern "C" void copySubstateFromGPU(Qureg q, long long int startInd, long long int numAmps) {
+    asPlanes(bcall("qureg_pull", "(iLL)", q._handle, startInd, numAmps),
+             q.stateVec.real + startInd, q.stateVec.imag + startInd, numAmps);
+}
+
+extern "C" void copyStateToGPU(Qureg q) {
+    asVoid(bcall("qureg_push", "(iLNN)", q._handle, 0LL,
+                 Bytes(q.stateVec.real, q.numAmpsTotal),
+                 Bytes(q.stateVec.imag, q.numAmpsTotal)));
+}
+
+extern "C" void copySubstateToGPU(Qureg q, long long int startInd, long long int numAmps) {
+    asVoid(bcall("qureg_push", "(iLNN)", q._handle, startInd,
+                 Bytes(q.stateVec.real + startInd, numAmps),
+                 Bytes(q.stateVec.imag + startInd, numAmps)));
+}
+
+/* ========================================================= matrix objects == */
+
+extern "C" ComplexMatrixN createComplexMatrixN(int numQubits) {
+    ComplexMatrixN m;
+    memset(&m, 0, sizeof(m));
+    if (numQubits < 1) {
+        invalidQuESTInputError("Invalid number of qubits. Must create >0.",
+                               "createComplexMatrixN");
+        return m;
+    }
+    long long dim = 1LL << numQubits;
+    m.numQubits = numQubits;
+    m.real = (qreal **) malloc(dim * sizeof(qreal *));
+    m.imag = (qreal **) malloc(dim * sizeof(qreal *));
+    for (long long i = 0; i < dim; i++) {
+        m.real[i] = (qreal *) calloc(dim, sizeof(qreal));
+        m.imag[i] = (qreal *) calloc(dim, sizeof(qreal));
+    }
+    return m;
+}
+
+extern "C" void destroyComplexMatrixN(ComplexMatrixN m) {
+    if (!m.real) {
+        invalidQuESTInputError("Matrix was not created.", "destroyComplexMatrixN");
+        return;
+    }
+    long long dim = 1LL << m.numQubits;
+    for (long long i = 0; i < dim; i++) {
+        free(m.real[i]);
+        free(m.imag[i]);
+    }
+    free(m.real);
+    free(m.imag);
+}
+
+/* Header C branch declares VLA params (contiguous row-major storage);
+ * C++ branch declares flat qreal*. Either way one pointer arrives. */
+extern "C" void initComplexMatrixN(ComplexMatrixN m, qreal *realFlat, qreal *imagFlat) {
+    long long dim = 1LL << m.numQubits;
+    for (long long i = 0; i < dim; i++) {
+        memcpy(m.real[i], realFlat + i * dim, dim * sizeof(qreal));
+        memcpy(m.imag[i], imagFlat + i * dim, dim * sizeof(qreal));
+    }
+}
+
+extern "C" ComplexMatrixN bindArraysToStackComplexMatrixN(
+        int numQubits, qreal *reFlat, qreal *imFlat,
+        qreal **reStorage, qreal **imStorage) {
+    ComplexMatrixN m;
+    m.numQubits = numQubits;
+    long long dim = 1LL << numQubits;
+    for (long long i = 0; i < dim; i++) {
+        reStorage[i] = reFlat + i * dim;
+        imStorage[i] = imFlat + i * dim;
+    }
+    m.real = reStorage;
+    m.imag = imStorage;
+    return m;
+}
+
+/* ======================================================= operator objects == */
+
+extern "C" PauliHamil createPauliHamil(int numQubits, int numSumTerms) {
+    PauliHamil h;
+    memset(&h, 0, sizeof(h));
+    if (numQubits < 1 || numSumTerms < 1) {
+        invalidQuESTInputError("Invalid PauliHamil parameters. Must be >0.",
+                               "createPauliHamil");
+        return h;
+    }
+    h.numQubits = numQubits;
+    h.numSumTerms = numSumTerms;
+    h.pauliCodes = (enum pauliOpType *) calloc((size_t) numSumTerms * numQubits,
+                                               sizeof(enum pauliOpType));
+    h.termCoeffs = (qreal *) calloc(numSumTerms, sizeof(qreal));
+    return h;
+}
+
+extern "C" void destroyPauliHamil(PauliHamil h) {
+    free(h.pauliCodes);
+    free(h.termCoeffs);
+}
+
+extern "C" void initPauliHamil(PauliHamil h, qreal *coeffs, enum pauliOpType *codes) {
+    memcpy(h.termCoeffs, coeffs, h.numSumTerms * sizeof(qreal));
+    memcpy(h.pauliCodes, codes,
+           (size_t) h.numSumTerms * h.numQubits * sizeof(enum pauliOpType));
+}
+
+extern "C" PauliHamil createPauliHamilFromFile(char *fn) {
+    ensureInit();
+    PauliHamil h;
+    memset(&h, 0, sizeof(h));
+    PyObject *r = bcall("parse_hamil_file", "(s)", fn);
+    if (!r) return h;
+    int numQubits = (int) PyLong_AsLong(PyTuple_GetItem(r, 0));
+    int numTerms = (int) PyLong_AsLong(PyTuple_GetItem(r, 1));
+    h = createPauliHamil(numQubits, numTerms);
+    PyObject *codes = PyTuple_GetItem(r, 2);
+    PyObject *coeffs = PyTuple_GetItem(r, 3);
+    for (long long i = 0; i < (long long) numTerms * numQubits; i++)
+        h.pauliCodes[i] = (enum pauliOpType) PyLong_AsLong(PyList_GetItem(codes, i));
+    for (int i = 0; i < numTerms; i++)
+        h.termCoeffs[i] = PyFloat_AsDouble(PyList_GetItem(coeffs, i));
+    Py_DECREF(r);
+    return h;
+}
+
+extern "C" void reportPauliHamil(PauliHamil h) {
+    asVoid(apicall("reportPauliHamil", tup(1, PHPy(h))));
+}
+
+extern "C" DiagonalOp createDiagonalOp(int numQubits, QuESTEnv env) {
+    ensureInit();
+    DiagonalOp op;
+    memset(&op, 0, sizeof(op));
+    PyObject *r = bcall("diag_create", "(ii)", numQubits, env._handle);
+    if (!r) return op;
+    op._handle = (int) PyLong_AsLong(PyTuple_GetItem(r, 0));
+    long long numElems = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+    Py_DECREF(r);
+    op.numQubits = numQubits;
+    op.numChunks = 1;
+    op.chunkId = 0;
+    op.numElemsPerChunk = numElems;
+    op.real = (qreal *) calloc(numElems, sizeof(qreal));
+    op.imag = (qreal *) calloc(numElems, sizeof(qreal));
+    return op;
+}
+
+extern "C" void destroyDiagonalOp(DiagonalOp op, QuESTEnv env) {
+    (void) env;
+    asVoid(bcall("diag_destroy", "(i)", op._handle));
+    free(op.real);
+    free(op.imag);
+}
+
+extern "C" void syncDiagonalOp(DiagonalOp op) {
+    asVoid(bcall("diag_set", "(iLNN)", op._handle, 0LL,
+                 Bytes(op.real, op.numElemsPerChunk),
+                 Bytes(op.imag, op.numElemsPerChunk)));
+}
+
+extern "C" void initDiagonalOp(DiagonalOp op, qreal *real, qreal *imag) {
+    memcpy(op.real, real, op.numElemsPerChunk * sizeof(qreal));
+    memcpy(op.imag, imag, op.numElemsPerChunk * sizeof(qreal));
+    syncDiagonalOp(op);
+}
+
+extern "C" void setDiagonalOpElems(DiagonalOp op, long long int startInd,
+                                   qreal *real, qreal *imag, long long int numElems) {
+    if (startInd < 0 || numElems < 0 || startInd + numElems > op.numElemsPerChunk) {
+        invalidQuESTInputError("Invalid element indices for the diagonal operator.",
+                               "setDiagonalOpElems");
+        return;
+    }
+    memcpy(op.real + startInd, real, numElems * sizeof(qreal));
+    memcpy(op.imag + startInd, imag, numElems * sizeof(qreal));
+    asVoid(bcall("diag_set", "(iLNN)", op._handle, startInd,
+                 Bytes(real, numElems), Bytes(imag, numElems)));
+}
+
+extern "C" void initDiagonalOpFromPauliHamil(DiagonalOp op, PauliHamil h) {
+    asPlanes(bcall("diag_from_hamil", "(iiNN)", op._handle, h.numQubits,
+                   PauliList(h.pauliCodes, (long long) h.numSumTerms * h.numQubits),
+                   DList(h.termCoeffs, h.numSumTerms)),
+             op.real, op.imag, op.numElemsPerChunk);
+}
+
+extern "C" DiagonalOp createDiagonalOpFromPauliHamilFile(char *fn, QuESTEnv env) {
+    ensureInit();
+    DiagonalOp op;
+    memset(&op, 0, sizeof(op));
+    PyObject *r = bcall("diag_from_file", "(si)", fn, env._handle);
+    if (!r) return op;
+    op._handle = (int) PyLong_AsLong(PyTuple_GetItem(r, 0));
+    op.numQubits = (int) PyLong_AsLong(PyTuple_GetItem(r, 1));
+    op.numChunks = 1;
+    op.chunkId = 0;
+    op.numElemsPerChunk = 1LL << op.numQubits;
+    op.real = (qreal *) calloc(op.numElemsPerChunk, sizeof(qreal));
+    op.imag = (qreal *) calloc(op.numElemsPerChunk, sizeof(qreal));
+    char *b;
+    Py_ssize_t len;
+    PyBytes_AsStringAndSize(PyTuple_GetItem(r, 2), &b, &len);
+    memcpy(op.real, b, len);
+    PyBytes_AsStringAndSize(PyTuple_GetItem(r, 3), &b, &len);
+    memcpy(op.imag, b, len);
+    Py_DECREF(r);
+    return op;
+}
+
+extern "C" void applyDiagonalOp(Qureg q, DiagonalOp op) {
+    asVoid(apicall("applyDiagonalOp", tup(2, QOBJ(q), DOBJ(op))));
+}
+
+extern "C" Complex calcExpecDiagonalOp(Qureg q, DiagonalOp op) {
+    PyObject *r = bcall("calc_expec_diag", "(ii)", q._handle, op._handle);
+    return asC(r);
+}
+
+extern "C" SubDiagonalOp createSubDiagonalOp(int numQubits) {
+    SubDiagonalOp op;
+    memset(&op, 0, sizeof(op));
+    if (numQubits < 1) {
+        invalidQuESTInputError("Invalid number of qubits. Must be >0.",
+                               "createSubDiagonalOp");
+        return op;
+    }
+    op.numQubits = numQubits;
+    op.numElems = 1LL << numQubits;
+    op.real = (qreal *) calloc(op.numElems, sizeof(qreal));
+    op.imag = (qreal *) calloc(op.numElems, sizeof(qreal));
+    return op;
+}
+
+extern "C" void destroySubDiagonalOp(SubDiagonalOp op) {
+    free(op.real);
+    free(op.imag);
+}
+
+extern "C" void diagonalUnitary(Qureg q, int *targets, int numTargets, SubDiagonalOp op) {
+    asVoid(apicall("diagonalUnitary",
+                   tup(3, QOBJ(q), IntList(targets, numTargets), SDPy(op))));
+}
+
+extern "C" void applySubDiagonalOp(Qureg q, int *targets, int numTargets, SubDiagonalOp op) {
+    asVoid(apicall("applySubDiagonalOp",
+                   tup(3, QOBJ(q), IntList(targets, numTargets), SDPy(op))));
+}
+
+extern "C" void applyGateSubDiagonalOp(Qureg q, int *targets, int numTargets, SubDiagonalOp op) {
+    asVoid(apicall("applyGateSubDiagonalOp",
+                   tup(3, QOBJ(q), IntList(targets, numTargets), SDPy(op))));
+}
+
+/* ==================================================== state initialisation == */
+
+extern "C" void initBlankState(Qureg q) { asVoid(apicall("initBlankState", tup(1, QOBJ(q)))); }
+extern "C" void initZeroState(Qureg q) { asVoid(apicall("initZeroState", tup(1, QOBJ(q)))); }
+extern "C" void initPlusState(Qureg q) { asVoid(apicall("initPlusState", tup(1, QOBJ(q)))); }
+extern "C" void initDebugState(Qureg q) { asVoid(apicall("initDebugState", tup(1, QOBJ(q)))); }
+
+extern "C" void initClassicalState(Qureg q, long long int stateInd) {
+    asVoid(apicall("initClassicalState", tup(2, QOBJ(q), I(stateInd))));
+}
+
+extern "C" void initPureState(Qureg q, Qureg pure) {
+    asVoid(apicall("initPureState", tup(2, QOBJ(q), QOBJ(pure))));
+}
+
+extern "C" void initStateFromAmps(Qureg q, qreal *reals, qreal *imags) {
+    asVoid(bcall("init_state_from_amps", "(iNN)", q._handle,
+                 Bytes(reals, q.numAmpsTotal), Bytes(imags, q.numAmpsTotal)));
+}
+
+extern "C" void setAmps(Qureg q, long long int startInd, qreal *reals, qreal *imags,
+                        long long int numAmps) {
+    asVoid(bcall("set_amps", "(iLNN)", q._handle, startInd,
+                 Bytes(reals, numAmps), Bytes(imags, numAmps)));
+}
+
+extern "C" void setDensityAmps(Qureg q, long long int startRow, long long int startCol,
+                               qreal *reals, qreal *imags, long long int numAmps) {
+    asVoid(bcall("set_density_amps", "(iLLNN)", q._handle, startRow, startCol,
+                 Bytes(reals, numAmps), Bytes(imags, numAmps)));
+}
+
+extern "C" void setQuregToPauliHamil(Qureg q, PauliHamil h) {
+    asVoid(apicall("setQuregToPauliHamil", tup(2, QOBJ(q), PHPy(h))));
+}
+
+extern "C" void cloneQureg(Qureg target, Qureg copy) {
+    asVoid(apicall("cloneQureg", tup(2, QOBJ(target), QOBJ(copy))));
+}
+
+extern "C" void setWeightedQureg(Complex fac1, Qureg q1, Complex fac2, Qureg q2,
+                                 Complex facOut, Qureg out) {
+    asVoid(apicall("setWeightedQureg",
+                   tup(6, CPy(fac1), QOBJ(q1), CPy(fac2), QOBJ(q2), CPy(facOut), QOBJ(out))));
+}
+
+/* ================================================================ unitaries == */
+
+#define GATE_Q(NAME) \
+    extern "C" void NAME(Qureg q, int a) { asVoid(apicall(#NAME, tup(2, QOBJ(q), I(a)))); }
+
+#define GATE_QQ(NAME) \
+    extern "C" void NAME(Qureg q, int a, int b) { \
+        asVoid(apicall(#NAME, tup(3, QOBJ(q), I(a), I(b)))); }
+
+#define GATE_QD(NAME) \
+    extern "C" void NAME(Qureg q, int a, qreal d) { \
+        asVoid(apicall(#NAME, tup(3, QOBJ(q), I(a), D(d)))); }
+
+#define GATE_QQD(NAME) \
+    extern "C" void NAME(Qureg q, int a, int b, qreal d) { \
+        asVoid(apicall(#NAME, tup(4, QOBJ(q), I(a), I(b), D(d)))); }
+
+GATE_Q(pauliX)
+GATE_Q(pauliY)
+GATE_Q(pauliZ)
+GATE_Q(hadamard)
+GATE_Q(sGate)
+GATE_Q(tGate)
+GATE_QQ(controlledNot)
+GATE_QQ(controlledPauliY)
+GATE_QQ(controlledPhaseFlip)
+GATE_QQ(swapGate)
+GATE_QQ(sqrtSwapGate)
+GATE_QD(phaseShift)
+GATE_QD(rotateX)
+GATE_QD(rotateY)
+GATE_QD(rotateZ)
+GATE_QQD(controlledPhaseShift)
+GATE_QQD(controlledRotateX)
+GATE_QQD(controlledRotateY)
+GATE_QQD(controlledRotateZ)
+
+extern "C" void rotateAroundAxis(Qureg q, int rotQubit, qreal angle, Vector axis) {
+    asVoid(apicall("rotateAroundAxis", tup(4, QOBJ(q), I(rotQubit), D(angle), VPy(axis))));
+}
+
+extern "C" void controlledRotateAroundAxis(Qureg q, int controlQubit, int targetQubit,
+                                           qreal angle, Vector axis) {
+    asVoid(apicall("controlledRotateAroundAxis",
+                   tup(5, QOBJ(q), I(controlQubit), I(targetQubit), D(angle), VPy(axis))));
+}
+
+extern "C" void compactUnitary(Qureg q, int targetQubit, Complex alpha, Complex beta) {
+    asVoid(apicall("compactUnitary", tup(4, QOBJ(q), I(targetQubit), CPy(alpha), CPy(beta))));
+}
+
+extern "C" void controlledCompactUnitary(Qureg q, int controlQubit, int targetQubit,
+                                         Complex alpha, Complex beta) {
+    asVoid(apicall("controlledCompactUnitary",
+                   tup(5, QOBJ(q), I(controlQubit), I(targetQubit), CPy(alpha), CPy(beta))));
+}
+
+extern "C" void unitary(Qureg q, int targetQubit, ComplexMatrix2 u) {
+    asVoid(apicall("unitary", tup(3, QOBJ(q), I(targetQubit), M2Py(u))));
+}
+
+extern "C" void controlledUnitary(Qureg q, int controlQubit, int targetQubit, ComplexMatrix2 u) {
+    asVoid(apicall("controlledUnitary",
+                   tup(4, QOBJ(q), I(controlQubit), I(targetQubit), M2Py(u))));
+}
+
+extern "C" void multiControlledUnitary(Qureg q, int *ctrls, int numCtrls, int target,
+                                       ComplexMatrix2 u) {
+    asVoid(apicall("multiControlledUnitary",
+                   tup(4, QOBJ(q), IntList(ctrls, numCtrls), I(target), M2Py(u))));
+}
+
+extern "C" void multiStateControlledUnitary(Qureg q, int *ctrls, int *states, int numCtrls,
+                                            int target, ComplexMatrix2 u) {
+    asVoid(apicall("multiStateControlledUnitary",
+                   tup(5, QOBJ(q), IntList(ctrls, numCtrls), IntList(states, numCtrls),
+                       I(target), M2Py(u))));
+}
+
+extern "C" void multiControlledPhaseShift(Qureg q, int *qubits, int numQubits, qreal angle) {
+    asVoid(apicall("multiControlledPhaseShift",
+                   tup(3, QOBJ(q), IntList(qubits, numQubits), D(angle))));
+}
+
+extern "C" void multiControlledPhaseFlip(Qureg q, int *qubits, int numQubits) {
+    asVoid(apicall("multiControlledPhaseFlip", tup(2, QOBJ(q), IntList(qubits, numQubits))));
+}
+
+extern "C" void multiQubitNot(Qureg q, int *targs, int numTargs) {
+    asVoid(apicall("multiQubitNot", tup(2, QOBJ(q), IntList(targs, numTargs))));
+}
+
+extern "C" void multiControlledMultiQubitNot(Qureg q, int *ctrls, int numCtrls,
+                                             int *targs, int numTargs) {
+    asVoid(apicall("multiControlledMultiQubitNot",
+                   tup(3, QOBJ(q), IntList(ctrls, numCtrls), IntList(targs, numTargs))));
+}
+
+extern "C" void multiRotateZ(Qureg q, int *qubits, int numQubits, qreal angle) {
+    asVoid(apicall("multiRotateZ", tup(3, QOBJ(q), IntList(qubits, numQubits), D(angle))));
+}
+
+extern "C" void multiRotatePauli(Qureg q, int *targs, enum pauliOpType *paulis,
+                                 int numTargs, qreal angle) {
+    asVoid(apicall("multiRotatePauli",
+                   tup(4, QOBJ(q), IntList(targs, numTargs), PauliList(paulis, numTargs),
+                       D(angle))));
+}
+
+extern "C" void multiControlledMultiRotateZ(Qureg q, int *ctrls, int numCtrls,
+                                            int *targs, int numTargs, qreal angle) {
+    asVoid(apicall("multiControlledMultiRotateZ",
+                   tup(4, QOBJ(q), IntList(ctrls, numCtrls), IntList(targs, numTargs),
+                       D(angle))));
+}
+
+extern "C" void multiControlledMultiRotatePauli(Qureg q, int *ctrls, int numCtrls,
+                                                int *targs, enum pauliOpType *paulis,
+                                                int numTargs, qreal angle) {
+    asVoid(apicall("multiControlledMultiRotatePauli",
+                   tup(5, QOBJ(q), IntList(ctrls, numCtrls), IntList(targs, numTargs),
+                       PauliList(paulis, numTargs), D(angle))));
+}
+
+extern "C" void twoQubitUnitary(Qureg q, int t1, int t2, ComplexMatrix4 u) {
+    asVoid(apicall("twoQubitUnitary", tup(4, QOBJ(q), I(t1), I(t2), M4Py(u))));
+}
+
+extern "C" void controlledTwoQubitUnitary(Qureg q, int ctrl, int t1, int t2, ComplexMatrix4 u) {
+    asVoid(apicall("controlledTwoQubitUnitary",
+                   tup(5, QOBJ(q), I(ctrl), I(t1), I(t2), M4Py(u))));
+}
+
+extern "C" void multiControlledTwoQubitUnitary(Qureg q, int *ctrls, int numCtrls,
+                                               int t1, int t2, ComplexMatrix4 u) {
+    asVoid(apicall("multiControlledTwoQubitUnitary",
+                   tup(5, QOBJ(q), IntList(ctrls, numCtrls), I(t1), I(t2), M4Py(u))));
+}
+
+extern "C" void multiQubitUnitary(Qureg q, int *targs, int numTargs, ComplexMatrixN u) {
+    asVoid(apicall("multiQubitUnitary", tup(3, QOBJ(q), IntList(targs, numTargs), MNPy(u))));
+}
+
+extern "C" void controlledMultiQubitUnitary(Qureg q, int ctrl, int *targs, int numTargs,
+                                            ComplexMatrixN u) {
+    asVoid(apicall("controlledMultiQubitUnitary",
+                   tup(4, QOBJ(q), I(ctrl), IntList(targs, numTargs), MNPy(u))));
+}
+
+extern "C" void multiControlledMultiQubitUnitary(Qureg q, int *ctrls, int numCtrls,
+                                                 int *targs, int numTargs, ComplexMatrixN u) {
+    asVoid(apicall("multiControlledMultiQubitUnitary",
+                   tup(4, QOBJ(q), IntList(ctrls, numCtrls), IntList(targs, numTargs),
+                       MNPy(u))));
+}
+
+/* ================================================ measurement and collapse == */
+
+extern "C" int measure(Qureg q, int measureQubit) {
+    return asI(apicall("measure", tup(2, QOBJ(q), I(measureQubit))));
+}
+
+extern "C" int measureWithStats(Qureg q, int measureQubit, qreal *outcomeProb) {
+    PyObject *r = apicall("measureWithStats", tup(2, QOBJ(q), I(measureQubit)));
+    if (!r) return 0;
+    int outcome = (int) PyLong_AsLong(PyTuple_GetItem(r, 0));
+    if (outcomeProb) *outcomeProb = PyFloat_AsDouble(PyTuple_GetItem(r, 1));
+    Py_DECREF(r);
+    return outcome;
+}
+
+extern "C" qreal collapseToOutcome(Qureg q, int measureQubit, int outcome) {
+    return asD(apicall("collapseToOutcome", tup(3, QOBJ(q), I(measureQubit), I(outcome))));
+}
+
+extern "C" void applyProjector(Qureg q, int qubit, int outcome) {
+    asVoid(apicall("applyProjector", tup(3, QOBJ(q), I(qubit), I(outcome))));
+}
+
+/* ============================================================= decoherence == */
+
+extern "C" void mixDephasing(Qureg q, int t, qreal prob) {
+    asVoid(apicall("mixDephasing", tup(3, QOBJ(q), I(t), D(prob))));
+}
+
+extern "C" void mixTwoQubitDephasing(Qureg q, int q1, int q2, qreal prob) {
+    asVoid(apicall("mixTwoQubitDephasing", tup(4, QOBJ(q), I(q1), I(q2), D(prob))));
+}
+
+extern "C" void mixDepolarising(Qureg q, int t, qreal prob) {
+    asVoid(apicall("mixDepolarising", tup(3, QOBJ(q), I(t), D(prob))));
+}
+
+extern "C" void mixTwoQubitDepolarising(Qureg q, int q1, int q2, qreal prob) {
+    asVoid(apicall("mixTwoQubitDepolarising", tup(4, QOBJ(q), I(q1), I(q2), D(prob))));
+}
+
+extern "C" void mixDamping(Qureg q, int t, qreal prob) {
+    asVoid(apicall("mixDamping", tup(3, QOBJ(q), I(t), D(prob))));
+}
+
+extern "C" void mixPauli(Qureg q, int t, qreal pX, qreal pY, qreal pZ) {
+    asVoid(apicall("mixPauli", tup(5, QOBJ(q), I(t), D(pX), D(pY), D(pZ))));
+}
+
+extern "C" void mixDensityMatrix(Qureg combine, qreal prob, Qureg other) {
+    asVoid(apicall("mixDensityMatrix", tup(3, QOBJ(combine), D(prob), QOBJ(other))));
+}
+
+extern "C" void mixKrausMap(Qureg q, int t, ComplexMatrix2 *ops, int numOps) {
+    asVoid(apicall("mixKrausMap", tup(3, QOBJ(q), I(t), M2ListPy(ops, numOps))));
+}
+
+extern "C" void mixTwoQubitKrausMap(Qureg q, int t1, int t2, ComplexMatrix4 *ops, int numOps) {
+    asVoid(apicall("mixTwoQubitKrausMap",
+                   tup(4, QOBJ(q), I(t1), I(t2), M4ListPy(ops, numOps))));
+}
+
+extern "C" void mixMultiQubitKrausMap(Qureg q, int *targs, int numTargs,
+                                      ComplexMatrixN *ops, int numOps) {
+    asVoid(apicall("mixMultiQubitKrausMap",
+                   tup(3, QOBJ(q), IntList(targs, numTargs), MNListPy(ops, numOps))));
+}
+
+extern "C" void mixNonTPKrausMap(Qureg q, int t, ComplexMatrix2 *ops, int numOps) {
+    asVoid(apicall("mixNonTPKrausMap", tup(3, QOBJ(q), I(t), M2ListPy(ops, numOps))));
+}
+
+extern "C" void mixNonTPTwoQubitKrausMap(Qureg q, int t1, int t2,
+                                         ComplexMatrix4 *ops, int numOps) {
+    asVoid(apicall("mixNonTPTwoQubitKrausMap",
+                   tup(4, QOBJ(q), I(t1), I(t2), M4ListPy(ops, numOps))));
+}
+
+extern "C" void mixNonTPMultiQubitKrausMap(Qureg q, int *targs, int numTargs,
+                                           ComplexMatrixN *ops, int numOps) {
+    asVoid(apicall("mixNonTPMultiQubitKrausMap",
+                   tup(3, QOBJ(q), IntList(targs, numTargs), MNListPy(ops, numOps))));
+}
+
+/* ============================================================ calculations == */
+
+extern "C" qreal calcTotalProb(Qureg q) {
+    return asD(apicall("calcTotalProb", tup(1, QOBJ(q))));
+}
+
+extern "C" qreal calcProbOfOutcome(Qureg q, int measureQubit, int outcome) {
+    return asD(apicall("calcProbOfOutcome", tup(3, QOBJ(q), I(measureQubit), I(outcome))));
+}
+
+extern "C" void calcProbOfAllOutcomes(qreal *outcomeProbs, Qureg q, int *qubits, int numQubits) {
+    PyObject *r = bcall("prob_all_outcomes", "(iN)", q._handle, IntList(qubits, numQubits));
+    if (!r) return;
+    char *b;
+    Py_ssize_t len;
+    PyBytes_AsStringAndSize(r, &b, &len);
+    memcpy(outcomeProbs, b, len);
+    Py_DECREF(r);
+}
+
+extern "C" Complex calcInnerProduct(Qureg bra, Qureg ket) {
+    return asC(apicall("calcInnerProduct", tup(2, QOBJ(bra), QOBJ(ket))));
+}
+
+extern "C" qreal calcDensityInnerProduct(Qureg rho1, Qureg rho2) {
+    return asD(apicall("calcDensityInnerProduct", tup(2, QOBJ(rho1), QOBJ(rho2))));
+}
+
+extern "C" qreal calcPurity(Qureg q) {
+    return asD(apicall("calcPurity", tup(1, QOBJ(q))));
+}
+
+extern "C" qreal calcFidelity(Qureg q, Qureg pureState) {
+    return asD(apicall("calcFidelity", tup(2, QOBJ(q), QOBJ(pureState))));
+}
+
+extern "C" qreal calcHilbertSchmidtDistance(Qureg a, Qureg b) {
+    return asD(apicall("calcHilbertSchmidtDistance", tup(2, QOBJ(a), QOBJ(b))));
+}
+
+extern "C" qreal calcExpecPauliProd(Qureg q, int *targs, enum pauliOpType *paulis,
+                                    int numTargs, Qureg workspace) {
+    return asD(apicall("calcExpecPauliProd",
+                       tup(4, QOBJ(q), IntList(targs, numTargs),
+                           PauliList(paulis, numTargs), QOBJ(workspace))));
+}
+
+extern "C" qreal calcExpecPauliSum(Qureg q, enum pauliOpType *allCodes, qreal *coeffs,
+                                   int numSumTerms, Qureg workspace) {
+    return asD(apicall("calcExpecPauliSum",
+                       tup(4, QOBJ(q),
+                           PauliList(allCodes, (long long) numSumTerms * q.numQubitsRepresented),
+                           DList(coeffs, numSumTerms), QOBJ(workspace))));
+}
+
+extern "C" qreal calcExpecPauliHamil(Qureg q, PauliHamil h, Qureg workspace) {
+    return asD(apicall("calcExpecPauliHamil", tup(3, QOBJ(q), PHPy(h), QOBJ(workspace))));
+}
+
+extern "C" Complex getAmp(Qureg q, long long int index) {
+    return asC(apicall("getAmp", tup(2, QOBJ(q), I(index))));
+}
+
+extern "C" qreal getRealAmp(Qureg q, long long int index) {
+    return asD(apicall("getRealAmp", tup(2, QOBJ(q), I(index))));
+}
+
+extern "C" qreal getImagAmp(Qureg q, long long int index) {
+    return asD(apicall("getImagAmp", tup(2, QOBJ(q), I(index))));
+}
+
+extern "C" qreal getProbAmp(Qureg q, long long int index) {
+    return asD(apicall("getProbAmp", tup(2, QOBJ(q), I(index))));
+}
+
+extern "C" Complex getDensityAmp(Qureg q, long long int row, long long int col) {
+    return asC(apicall("getDensityAmp", tup(3, QOBJ(q), I(row), I(col))));
+}
+
+/* =============================================================== operators == */
+
+extern "C" void applyPauliSum(Qureg in, enum pauliOpType *allCodes, qreal *coeffs,
+                              int numSumTerms, Qureg out) {
+    asVoid(apicall("applyPauliSum",
+                   tup(4, QOBJ(in),
+                       PauliList(allCodes, (long long) numSumTerms * in.numQubitsRepresented),
+                       DList(coeffs, numSumTerms), QOBJ(out))));
+}
+
+extern "C" void applyPauliHamil(Qureg in, PauliHamil h, Qureg out) {
+    asVoid(apicall("applyPauliHamil", tup(3, QOBJ(in), PHPy(h), QOBJ(out))));
+}
+
+extern "C" void applyTrotterCircuit(Qureg q, PauliHamil h, qreal time, int order, int reps) {
+    asVoid(apicall("applyTrotterCircuit",
+                   tup(5, QOBJ(q), PHPy(h), D(time), I(order), I(reps))));
+}
+
+extern "C" void applyMatrix2(Qureg q, int target, ComplexMatrix2 u) {
+    asVoid(apicall("applyMatrix2", tup(3, QOBJ(q), I(target), M2Py(u))));
+}
+
+extern "C" void applyMatrix4(Qureg q, int t1, int t2, ComplexMatrix4 u) {
+    asVoid(apicall("applyMatrix4", tup(4, QOBJ(q), I(t1), I(t2), M4Py(u))));
+}
+
+extern "C" void applyMatrixN(Qureg q, int *targs, int numTargs, ComplexMatrixN u) {
+    asVoid(apicall("applyMatrixN", tup(3, QOBJ(q), IntList(targs, numTargs), MNPy(u))));
+}
+
+extern "C" void applyGateMatrixN(Qureg q, int *targs, int numTargs, ComplexMatrixN u) {
+    asVoid(apicall("applyGateMatrixN", tup(3, QOBJ(q), IntList(targs, numTargs), MNPy(u))));
+}
+
+extern "C" void applyMultiControlledMatrixN(Qureg q, int *ctrls, int numCtrls,
+                                            int *targs, int numTargs, ComplexMatrixN u) {
+    asVoid(apicall("applyMultiControlledMatrixN",
+                   tup(4, QOBJ(q), IntList(ctrls, numCtrls), IntList(targs, numTargs),
+                       MNPy(u))));
+}
+
+extern "C" void applyMultiControlledGateMatrixN(Qureg q, int *ctrls, int numCtrls,
+                                                int *targs, int numTargs, ComplexMatrixN m) {
+    asVoid(apicall("applyMultiControlledGateMatrixN",
+                   tup(4, QOBJ(q), IntList(ctrls, numCtrls), IntList(targs, numTargs),
+                       MNPy(m))));
+}
+
+static long long sumInts(const int *a, int n) {
+    long long s = 0;
+    for (int i = 0; i < n; i++) s += a[i];
+    return s;
+}
+
+extern "C" void applyPhaseFunc(Qureg q, int *qubits, int numQubits,
+                               enum bitEncoding encoding, qreal *coeffs,
+                               qreal *exponents, int numTerms) {
+    asVoid(apicall("applyPhaseFunc",
+                   tup(5, QOBJ(q), IntList(qubits, numQubits), I((int) encoding),
+                       DList(coeffs, numTerms), DList(exponents, numTerms))));
+}
+
+extern "C" void applyPhaseFuncOverrides(Qureg q, int *qubits, int numQubits,
+                                        enum bitEncoding encoding, qreal *coeffs,
+                                        qreal *exponents, int numTerms,
+                                        long long int *overrideInds, qreal *overridePhases,
+                                        int numOverrides) {
+    asVoid(apicall("applyPhaseFuncOverrides",
+                   tup(7, QOBJ(q), IntList(qubits, numQubits), I((int) encoding),
+                       DList(coeffs, numTerms), DList(exponents, numTerms),
+                       LLList(overrideInds, numOverrides), DList(overridePhases, numOverrides))));
+}
+
+extern "C" void applyMultiVarPhaseFunc(Qureg q, int *qubits, int *numQubitsPerReg,
+                                       int numRegs, enum bitEncoding encoding,
+                                       qreal *coeffs, qreal *exponents, int *numTermsPerReg) {
+    long long totQb = sumInts(numQubitsPerReg, numRegs);
+    long long totTm = sumInts(numTermsPerReg, numRegs);
+    asVoid(apicall("applyMultiVarPhaseFunc",
+                   tup(7, QOBJ(q), IntList(qubits, totQb), IntList(numQubitsPerReg, numRegs),
+                       I((int) encoding), DList(coeffs, totTm), DList(exponents, totTm),
+                       IntList(numTermsPerReg, numRegs))));
+}
+
+extern "C" void applyMultiVarPhaseFuncOverrides(Qureg q, int *qubits, int *numQubitsPerReg,
+                                                int numRegs, enum bitEncoding encoding,
+                                                qreal *coeffs, qreal *exponents,
+                                                int *numTermsPerReg,
+                                                long long int *overrideInds,
+                                                qreal *overridePhases, int numOverrides) {
+    long long totQb = sumInts(numQubitsPerReg, numRegs);
+    long long totTm = sumInts(numTermsPerReg, numRegs);
+    asVoid(apicall("applyMultiVarPhaseFuncOverrides",
+                   tup(9, QOBJ(q), IntList(qubits, totQb), IntList(numQubitsPerReg, numRegs),
+                       I((int) encoding), DList(coeffs, totTm), DList(exponents, totTm),
+                       IntList(numTermsPerReg, numRegs),
+                       LLList(overrideInds, (long long) numOverrides * numRegs),
+                       DList(overridePhases, numOverrides))));
+}
+
+extern "C" void applyNamedPhaseFunc(Qureg q, int *qubits, int *numQubitsPerReg, int numRegs,
+                                    enum bitEncoding encoding, enum phaseFunc code) {
+    long long totQb = sumInts(numQubitsPerReg, numRegs);
+    asVoid(apicall("applyNamedPhaseFunc",
+                   tup(5, QOBJ(q), IntList(qubits, totQb), IntList(numQubitsPerReg, numRegs),
+                       I((int) encoding), I((int) code))));
+}
+
+extern "C" void applyNamedPhaseFuncOverrides(Qureg q, int *qubits, int *numQubitsPerReg,
+                                             int numRegs, enum bitEncoding encoding,
+                                             enum phaseFunc code, long long int *overrideInds,
+                                             qreal *overridePhases, int numOverrides) {
+    long long totQb = sumInts(numQubitsPerReg, numRegs);
+    asVoid(apicall("applyNamedPhaseFuncOverrides",
+                   tup(7, QOBJ(q), IntList(qubits, totQb), IntList(numQubitsPerReg, numRegs),
+                       I((int) encoding), I((int) code),
+                       LLList(overrideInds, (long long) numOverrides * numRegs),
+                       DList(overridePhases, numOverrides))));
+}
+
+extern "C" void applyParamNamedPhaseFunc(Qureg q, int *qubits, int *numQubitsPerReg,
+                                         int numRegs, enum bitEncoding encoding,
+                                         enum phaseFunc code, qreal *params, int numParams) {
+    long long totQb = sumInts(numQubitsPerReg, numRegs);
+    asVoid(apicall("applyParamNamedPhaseFunc",
+                   tup(6, QOBJ(q), IntList(qubits, totQb), IntList(numQubitsPerReg, numRegs),
+                       I((int) encoding), I((int) code), DList(params, numParams))));
+}
+
+extern "C" void applyParamNamedPhaseFuncOverrides(Qureg q, int *qubits, int *numQubitsPerReg,
+                                                  int numRegs, enum bitEncoding encoding,
+                                                  enum phaseFunc code, qreal *params,
+                                                  int numParams, long long int *overrideInds,
+                                                  qreal *overridePhases, int numOverrides) {
+    long long totQb = sumInts(numQubitsPerReg, numRegs);
+    asVoid(apicall("applyParamNamedPhaseFuncOverrides",
+                   tup(8, QOBJ(q), IntList(qubits, totQb), IntList(numQubitsPerReg, numRegs),
+                       I((int) encoding), I((int) code), DList(params, numParams),
+                       LLList(overrideInds, (long long) numOverrides * numRegs),
+                       DList(overridePhases, numOverrides))));
+}
+
+extern "C" void applyFullQFT(Qureg q) {
+    asVoid(apicall("applyFullQFT", tup(1, QOBJ(q))));
+}
+
+extern "C" void applyQFT(Qureg q, int *qubits, int numQubits) {
+    asVoid(apicall("applyQFT", tup(2, QOBJ(q), IntList(qubits, numQubits))));
+}
+
+/* ======================================================== reporting / QASM == */
+
+extern "C" void reportState(Qureg q) { asVoid(apicall("reportState", tup(1, QOBJ(q)))); }
+
+extern "C" void reportStateToScreen(Qureg q, QuESTEnv env, int reportRank) {
+    asVoid(apicall("reportStateToScreen", tup(3, QOBJ(q), EOBJ(env), I(reportRank))));
+}
+
+extern "C" void reportQuregParams(Qureg q) {
+    asVoid(apicall("reportQuregParams", tup(1, QOBJ(q))));
+}
+
+extern "C" void startRecordingQASM(Qureg q) {
+    asVoid(apicall("startRecordingQASM", tup(1, QOBJ(q))));
+}
+
+extern "C" void stopRecordingQASM(Qureg q) {
+    asVoid(apicall("stopRecordingQASM", tup(1, QOBJ(q))));
+}
+
+extern "C" void clearRecordedQASM(Qureg q) {
+    asVoid(apicall("clearRecordedQASM", tup(1, QOBJ(q))));
+}
+
+extern "C" void printRecordedQASM(Qureg q) {
+    asVoid(apicall("printRecordedQASM", tup(1, QOBJ(q))));
+}
+
+extern "C" void writeRecordedQASMToFile(Qureg q, char *filename) {
+    asVoid(apicall("writeRecordedQASMToFile", tup(2, QOBJ(q), S(filename))));
+}

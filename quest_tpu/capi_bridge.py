@@ -1,0 +1,185 @@
+"""Bridge between the native C API shim (native/src/capi.cpp) and the core.
+
+The C layer embeds CPython, imports this module once, and funnels every API
+call through it. Objects with identity (env, Qureg, DiagonalOp) live in
+handle registries here — the C structs carry only an int handle plus
+value-type mirror fields — while value-like operands (matrices, Pauli
+strings, SubDiagonalOps) are marshalled per call.
+
+The reference keeps its whole runtime in C (QuEST.c -> backends); here the
+C runtime is a thin dispatch veneer and the engine is the JAX/XLA core, so
+a reference user program gets TPU execution from an unchanged .c file.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+import quest_tpu as qt
+from . import datatypes
+
+_HANDLES: dict[int, object] = {}
+_NEXT = itertools.count(1)
+
+
+def _register(obj) -> int:
+    h = next(_NEXT)
+    _HANDLES[h] = obj
+    return h
+
+
+def ref(handle: int):
+    """Resolve a C-side handle to its live core object."""
+    return _HANDLES[handle]
+
+
+def drop(handle: int) -> None:
+    _HANDLES.pop(handle, None)
+
+
+# ------------------------------------------------------------------- env --
+
+def env_create():
+    env = qt.createQuESTEnv()
+    return _register(env), env.rank, env.num_ranks, list(qt.getQuESTSeeds(env))
+
+
+def env_destroy(handle: int) -> None:
+    qt.destroyQuESTEnv(ref(handle))
+    drop(handle)
+
+
+def env_seed(handle: int, seeds) -> list:
+    qt.seedQuEST(ref(handle), [int(s) for s in seeds])
+    return list(qt.getQuESTSeeds(ref(handle)))
+
+
+def env_seed_default(handle: int) -> list:
+    qt.seedQuESTDefault(ref(handle))
+    return list(qt.getQuESTSeeds(ref(handle)))
+
+
+# ----------------------------------------------------------------- qureg --
+
+def qureg_create(num_qubits: int, env_handle: int, is_density: bool):
+    env = ref(env_handle)
+    make = qt.createDensityQureg if is_density else qt.createQureg
+    q = make(num_qubits, env)
+    return _register(q), q.num_qubits_in_state_vec, q.num_amps_total
+
+
+def qureg_clone(src_handle: int, env_handle: int):
+    q = qt.createCloneQureg(ref(src_handle), ref(env_handle))
+    return _register(q), q.num_qubits_in_state_vec, q.num_amps_total
+
+
+def qureg_destroy(handle: int) -> None:
+    qt.destroyQureg(ref(handle))
+    drop(handle)
+
+
+def _f64(buf) -> np.ndarray:
+    """Bulk data crosses the C boundary as raw float64 bytes, not lists."""
+    return np.frombuffer(buf, dtype=np.float64)
+
+
+def qureg_pull(handle: int, start: int, num: int) -> tuple:
+    """(real bytes, imag bytes) of amplitudes [start, start+num), float64."""
+    q = ref(handle)
+    mirror = qt.copySubstateFromGPU(q, start, num)
+    block = mirror[:, start:start + num].astype(np.float64)
+    return block[0].tobytes(), block[1].tobytes()
+
+
+def qureg_push(handle: int, start: int, re_b: bytes, im_b: bytes) -> None:
+    q = ref(handle)
+    re = _f64(re_b)
+    q.state_vec[0, start:start + len(re)] = re
+    q.state_vec[1, start:start + len(re)] = _f64(im_b)
+    qt.copySubstateToGPU(q, start, len(re))
+
+
+def set_amps(handle: int, start: int, re_b: bytes, im_b: bytes) -> None:
+    re = _f64(re_b)
+    qt.setAmps(ref(handle), start, re, _f64(im_b), len(re))
+
+
+def set_density_amps(handle: int, row: int, col: int, re_b: bytes, im_b: bytes) -> None:
+    re = _f64(re_b)
+    qt.setDensityAmps(ref(handle), row, col, re, _f64(im_b), len(re))
+
+
+def init_state_from_amps(handle: int, re_b: bytes, im_b: bytes) -> None:
+    qt.initStateFromAmps(ref(handle), _f64(re_b), _f64(im_b))
+
+
+def prob_all_outcomes(handle: int, qubits) -> bytes:
+    probs = qt.calcProbOfAllOutcomes(ref(handle), list(qubits))
+    return np.asarray(probs, dtype=np.float64).tobytes()
+
+
+# ------------------------------------------------------------- operators --
+
+def make_hamil(num_qubits: int, codes, coeffs) -> datatypes.PauliHamil:
+    h = qt.createPauliHamil(num_qubits, len(coeffs))
+    qt.initPauliHamil(h, [float(c) for c in coeffs], [int(c) for c in codes])
+    return h
+
+
+def parse_hamil_file(fn: str):
+    h = qt.createPauliHamilFromFile(fn)
+    return (h.num_qubits, h.num_sum_terms,
+            [int(c) for c in np.ravel(h.pauli_codes)],
+            [float(c) for c in h.term_coeffs])
+
+
+def make_subdiag(num_qubits: int, re_b: bytes, im_b: bytes) -> datatypes.SubDiagonalOp:
+    op = qt.createSubDiagonalOp(num_qubits)
+    op.elems[...] = _f64(re_b) + 1j * _f64(im_b)
+    return op
+
+
+def diag_create(num_qubits: int, env_handle: int):
+    op = qt.createDiagonalOp(num_qubits, ref(env_handle))
+    return _register(op), (1 << num_qubits)
+
+
+def diag_destroy(handle: int) -> None:
+    qt.destroyDiagonalOp(ref(handle))
+    drop(handle)
+
+
+def diag_set(handle: int, start: int, re_b: bytes, im_b: bytes) -> None:
+    re = _f64(re_b)
+    qt.setDiagonalOpElems(ref(handle), start, re, _f64(im_b), len(re))
+
+
+def _diag_elems(op) -> tuple:
+    elems = np.asarray(op.elems, dtype=np.float64)
+    return elems[0].tobytes(), elems[1].tobytes()
+
+
+def diag_from_hamil(handle: int, num_qubits: int, codes, coeffs) -> tuple:
+    """initDiagonalOpFromPauliHamil + pull elems back for the C host mirror."""
+    op = ref(handle)
+    qt.initDiagonalOpFromPauliHamil(op, make_hamil(num_qubits, codes, coeffs))
+    return _diag_elems(op)
+
+
+def diag_from_file(fn: str, env_handle: int):
+    op = qt.createDiagonalOpFromPauliHamilFile(fn, ref(env_handle))
+    re_b, im_b = _diag_elems(op)
+    return _register(op), op.num_qubits, re_b, im_b
+
+
+def calc_expec_diag(qureg_handle: int, diag_handle: int) -> complex:
+    return complex(qt.calcExpecDiagonalOp(ref(qureg_handle), ref(diag_handle)))
+
+
+# ---------------------------------------------------------------- generic --
+
+def call(fname: str, *args):
+    """Invoke a top-level quest_tpu function with pre-resolved arguments."""
+    return getattr(qt, fname)(*args)
