@@ -160,6 +160,62 @@ class Circuit:
             self._compiled[key] = fn
         return self._compiled[key]
 
+    def fused(self, max_qubits: int = 5, dtype=None) -> "Circuit":
+        """A new Circuit with runs of gates contracted into ``max_qubits``-
+        qubit unitaries at trace time (see :mod:`quest_tpu.fusion`).
+
+        Semantics-preserving for arbitrary tapes: entries that cannot be
+        captured as gate primitives (decoherence, phase functions, inits)
+        pass through unchanged and act as fusion barriers.
+        """
+        import numpy as np
+
+        from . import fusion
+        from .precision import real_dtype
+
+        p = fusion.plan(tuple(self._tape), self.num_qubits,
+                        np.dtype(dtype) if dtype else real_dtype(),
+                        max_qubits=max_qubits)
+        out = Circuit(self.num_qubits, self.is_density_matrix)
+        out._tape = fusion.as_tape(p)
+        return out
+
+    def blocks(self, max_gates: int) -> list:
+        """Split the tape into sub-circuits of at most ``max_gates`` gates.
+
+        One arbitrarily deep circuit as a single XLA program eventually
+        exhausts the compiler (the graph grows with tape length x state
+        size); chaining a few block-sized executables with donated buffers
+        keeps per-program compilation bounded while retaining fusion within
+        each block. Runtime cost is one extra dispatch per block.
+        """
+        if max_gates < 1:
+            raise ValueError("max_gates must be >= 1")
+        parts = []
+        for i in range(0, len(self._tape), max_gates):
+            part = Circuit(self.num_qubits, self.is_density_matrix)
+            part._tape = list(self._tape[i:i + max_gates])
+            parts.append(part)
+        return parts
+
+    def compiled_blocks(self, max_gates: int, donate: bool = True):
+        """Like :meth:`compiled`, but as a chain of block-sized executables.
+        Cached like :meth:`compiled` so repeated calls reuse the underlying
+        executables instead of retracing every block."""
+        from .parallel import scheduler as _dist
+        sched = _dist.active()
+        key = (("blocks", max_gates), donate, sched.mesh if sched else None)
+        if key not in self._compiled:
+            fns = [b.compiled(donate=donate) for b in self.blocks(max_gates)]
+
+            def chained(amps, _fns=tuple(fns)):
+                for f in _fns:
+                    amps = f(amps)
+                return amps
+
+            self._compiled[key] = chained
+        return self._compiled[key]
+
     def run(self, qureg: Qureg) -> Qureg:
         """Apply the circuit to ``qureg`` (mutates its amps, like the C API)."""
         if qureg.num_qubits_represented != self.num_qubits or \

@@ -40,6 +40,71 @@ def _plan(n, targets, controls):
     return (2,) + shape, perm, inverse_permutation(perm)
 
 
+#: windows whose low edge is below this get kron-expanded down to qubit 0 so
+#: the GEMM's K dimension is at least 2^_MIN_MINOR (=the 128-lane width);
+#: keeps every buffer's trailing dim >= 128 and avoids TPU tile padding.
+_MIN_MINOR = 7
+
+
+def _mxu_precision(dtype):
+    """Always HIGHEST: XLA:TPU's default silently drops matmul inputs to
+    bf16 -- catastrophic for amplitude evolution (observed 3e-3 norm drift in
+    an 8-amp state). HIGH (3-pass bf16) was measured no faster here and
+    drifted a 26q depth-8 circuit's norm to 0.9964 (vs 1.000002 at HIGHEST);
+    the dtype hook stays so a future backend can relax it deliberately."""
+    del dtype
+    return jax.lax.Precision.HIGHEST
+
+
+def _window_of(targets):
+    """(lo, hi) if ``targets`` is exactly the ascending run lo..hi, else None."""
+    t = len(targets)
+    lo = targets[0]
+    if targets == tuple(range(lo, lo + t)):
+        return lo, lo + t - 1
+    return None
+
+
+def _apply_matrix_window(amps, mr, mi, n, lo, hi):
+    """Layout-clean dense apply for a contiguous target window [lo, hi].
+
+    The general grouped-transpose path materialises high-rank tensors whose
+    trailing dims are 2-sized; the TPU's (8, 128) tile padding then inflates
+    them up to 64x (observed: a 512 MB state demanding a 32 GB allocation).
+    A contiguous window never needs a transpose:
+
+    - lo >= _MIN_MINOR: view (2, A, 2^t, 2^lo) and contract the 2^t axis
+      with M -- trailing dim 2^lo >= 128, no padding, MXU GEMM.
+    - lo < _MIN_MINOR: expand M to G = I (x) M (x) I over the low
+      w = max(hi+1, _MIN_MINOR) qubits and right-multiply the (2, R, 2^w)
+      view -- K in [128, 2048], the MXU sweet spot.
+    """
+    mm = partial(jnp.einsum, precision=_mxu_precision(amps.dtype))
+
+    def cplx_block(gr, gi):
+        # the complex product as ONE real contraction: out[p] = sum_q G4[p,q] x[q]
+        # with G4 = [[gr, -gi], [gi, gr]] -- reads the state once instead of
+        # four times (one dot_general, planes contracted alongside K).
+        return jnp.stack([jnp.stack([gr, -gi]), jnp.stack([gi, gr])])
+
+    if lo >= _MIN_MINOR:
+        dim = 1 << (hi - lo + 1)
+        x = amps.reshape(2, -1, dim, 1 << lo)
+        g4 = cplx_block(mr, mi)
+        out = mm("pqij,qajb->paib", g4, x)
+        return out.reshape(2, -1)
+
+    w = min(max(hi + 1, _MIN_MINOR), n)
+    eye_hi = jnp.eye(1 << (w - 1 - hi), dtype=mr.dtype)
+    eye_lo = jnp.eye(1 << lo, dtype=mr.dtype)
+    gr = jnp.kron(eye_hi, jnp.kron(mr, eye_lo))
+    gi = jnp.kron(eye_hi, jnp.kron(mi, eye_lo))
+    g4 = cplx_block(gr, gi)
+    x = amps.reshape(2, -1, 1 << w)
+    out = mm("pqij,qaj->pai", g4, x)
+    return out.reshape(2, -1)
+
+
 @partial(jax.jit, static_argnames=("n", "targets", "controls", "control_states", "conj"),
          donate_argnums=(0,))
 def apply_matrix(amps, matrix, *, n: int, targets: tuple[int, ...],
@@ -56,17 +121,21 @@ def apply_matrix(amps, matrix, *, n: int, targets: tuple[int, ...],
     t = len(targets)
     dim = 1 << t
     states = control_states if control_states else (1,) * len(controls)
-    shape, perm, inv = _plan(n, targets, controls)
-    tensor = amps.reshape(shape).transpose(perm)
 
     mr, mi = matrix[0], matrix[1]
     if conj:
         mi = -mi
 
-    # full-f32 matmuls: XLA:TPU's default precision drops matmul inputs to
-    # bf16, which is catastrophic for amplitude evolution (observed 3e-3 norm
-    # drift in an 8-amp state). HIGHEST keeps the MXU in full precision.
-    mm = partial(jnp.matmul, precision=jax.lax.Precision.HIGHEST)
+    if not controls:
+        win = _window_of(targets)
+        if win is not None:
+            return _apply_matrix_window(amps, mr, mi, n, *win)
+
+    shape, perm, inv = _plan(n, targets, controls)
+    tensor = amps.reshape(shape).transpose(perm)
+
+    # see _mxu_precision: never let XLA silently drop matmul inputs to bf16
+    mm = partial(jnp.matmul, precision=_mxu_precision(amps.dtype))
 
     def matvec(sub):
         # sub: (2, 2, 2, ..., rest) with t leading 2-axes after the plane

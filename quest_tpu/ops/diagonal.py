@@ -1,12 +1,12 @@
-"""Diagonal / phase-only kernels: no data movement, pure broadcasted multiply.
+"""Diagonal / phase-only kernels: no data movement, one fused pass.
 
 The reference implements these as mask-parity loops (phaseShiftByTerm
 ``QuEST_cpu.c:3113``, multiRotateZ ``QuEST_cpu.c:3235-3285``). On TPU a phase
-gate never needs a transpose: build planar factor tensors that broadcast
-against the grouped view (1-sized everywhere except the touched 2-sized axes)
-and complex-multiply the planes -- XLA fuses the whole thing into one VPU pass
-over HBM, and it works unchanged on sharded arrays (factors are replicated
-scalars).
+gate never reshapes or moves the state: the per-amplitude factor is computed
+from flat-index bits (iota + shifts) and either gathered from the 2^t-entry
+diagonal table or, for parity phases, derived from an XOR chain -- XLA fuses
+the whole thing into one VPU pass over HBM, and it works unchanged on sharded
+arrays (the iota is global under GSPMD).
 """
 
 from __future__ import annotations
@@ -16,32 +16,57 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .layout import grouped_axes
+
+def _flat_bits(num_flat: int, qubit: int):
+    """Elementwise bit-q of the flat amplitude index, shape (1, num_flat).
+
+    Built from a >=2-D iota (TPU requires it); stays fused into the consuming
+    multiply -- no reshape of the state, no materialised index array."""
+    i = jax.lax.broadcasted_iota(jnp.int32, (1, num_flat), 1)
+    return (i >> qubit) & 1
 
 
-def _axis_vec(values, axis: int, rank: int, dtype):
-    """A length-2 vector placed on one broadcast axis (axes count the grouped
-    view only; the planar axis is prepended by callers via [None])."""
-    shape = [1] * rank
-    shape[axis] = 2
-    return jnp.asarray(values, dtype=dtype).reshape(shape)
-
-
-def _control_selector(axis_of, controls, rank, dtype):
-    """Tensor that is 1 where all controls are 1, else 0 (broadcastable)."""
+def _ctrl_ok(num_flat: int, controls):
     sel = None
     for c in controls:
-        v = _axis_vec([0.0, 1.0], axis_of[c], rank, dtype)
-        sel = v if sel is None else sel * v
+        b = _flat_bits(num_flat, c)
+        sel = b if sel is None else sel & b
     return sel
 
 
-def _mul_factor(amps, shape, fr, fi):
-    """amps (2, 2^n) times planar factor (fr, fi) broadcast over ``shape``."""
-    t = amps.reshape((2,) + shape)
-    re = t[0] * fr - t[1] * fi
-    im = t[0] * fi + t[1] * fr
-    return jnp.stack([re, im]).reshape(2, -1)
+def _apply_diagonal_flat(amps, diag, targets, controls, conj):
+    """Layout-clean diagonal: phase factors computed elementwise over the
+    *flat* (2, 2^n) state from index bits.
+
+    The grouped-broadcast formulation reshapes the state to rank 2t+2 with
+    2-sized trailing axes; on TPU such views materialise with (8, 128) tile
+    padding -- observed 64x inflation (512 MB state -> 34 GB allocation) for
+    a 5-target diagonal at 26 qubits. Here the state is never reshaped: the
+    2^t-entry table is gathered by an index assembled from flat-index bits
+    (the same formulation as the explicit distributed backend,
+    parallel/exchange.py dist_apply_diag_phase), one pass at any width,
+    sharding-transparent (iota is global)."""
+    num = amps.shape[-1]
+    rdtype = amps.dtype
+    d = diag.astype(rdtype)
+    dr, di = d[0], d[1]
+    if conj:
+        di = -di
+
+    sel = jnp.zeros((1, num), jnp.int32)
+    for k, q in enumerate(targets):
+        sel = sel | (_flat_bits(num, q) << k)
+    fr = jnp.take(dr, sel[0])
+    fi = jnp.take(di, sel[0])
+
+    if controls:
+        ok = _ctrl_ok(num, controls)[0].astype(rdtype)
+        fr = 1 + ok * (fr - 1)
+        fi = ok * fi
+
+    re = amps[0] * fr - amps[1] * fi
+    im = amps[0] * fi + amps[1] * fr
+    return jnp.stack([re, im])
 
 
 @partial(jax.jit, static_argnames=("n", "targets", "controls", "conj"), donate_argnums=(0,))
@@ -54,29 +79,8 @@ def apply_diagonal(amps, diag, *, n: int, targets: tuple[int, ...],
     Covers phaseShift/sGate/tGate/rotateZ/controlledPhaseFlip/diagonalUnitary/
     applySubDiagonalOp (reference kernels ``QuEST_cpu.c:1339-1386,3113-3233``).
     """
-    t = len(targets)
-    shape, axis_of = grouped_axes(n, tuple(targets) + tuple(controls))
-    rank = len(shape)
-
-    # place the diagonal's bits onto their grouped axes:
-    # d has shape (2, 2^t) with bit k of the index belonging to targets[k]
-    d = diag.astype(amps.dtype).reshape((2,) + (2,) * t)  # planar, [b_{t-1},...,b_0]
-    order = [axis_of[q] for q in reversed(targets)]
-    perm = sorted(range(t), key=lambda i: order[i])
-    bshape = [1] * rank
-    for q in targets:
-        bshape[axis_of[q]] = 2
-    d = d.transpose([0] + [1 + p for p in perm]).reshape([2] + bshape)
-    fr, fi = d[0], d[1]
-    if conj:
-        fi = -fi
-
-    if controls:
-        sel = _control_selector(axis_of, controls, rank, amps.dtype)
-        fr = 1 + sel * (fr - 1)
-        fi = sel * fi
-
-    return _mul_factor(amps, shape, fr, fi)
+    del n
+    return _apply_diagonal_flat(amps, diag, targets, controls, conj)
 
 
 @partial(jax.jit, static_argnames=("n", "qubits", "controls", "conj"), donate_argnums=(0,))
@@ -85,19 +89,20 @@ def apply_parity_phase(amps, theta, *, n: int, qubits: tuple[int, ...],
     """exp(-i theta/2 * Z x Z x ... x Z) on ``qubits`` -- multiRotateZ and its
     controlled variant (reference mask-parity kernel ``QuEST_cpu.c:3235-3285``).
 
-    Avoids materialising the 2^t diagonal: (-1)^parity is a separable product
-    of per-axis [1,-1] vectors, so the factor is
-    cos(theta/2) - i sin(theta/2) * prod_q (-1)^{bit_q}, fully fused by XLA.
-    ``conj`` negates theta (density shadow op).
+    Computed elementwise over the flat state (no reshape, see
+    :func:`_apply_diagonal_flat` for why): the factor is
+    cos(theta/2) - i sin(theta/2) * (-1)^{parity of the target bits},
+    with the parity an XOR chain over index bits -- one fused VPU pass,
+    sharding-transparent. ``conj`` negates theta (density shadow op).
     """
-    shape, axis_of = grouped_axes(n, tuple(qubits) + tuple(controls))
-    rank = len(shape)
+    num = amps.shape[-1]
     rdtype = amps.dtype
 
-    sign = None
+    par = None
     for q in qubits:
-        v = _axis_vec([1.0, -1.0], axis_of[q], rank, rdtype)
-        sign = v if sign is None else sign * v
+        b = _flat_bits(num, q)
+        par = b if par is None else par ^ b
+    sign = (1 - 2 * par).astype(rdtype)
 
     theta = jnp.asarray(theta, dtype=rdtype)
     if conj:
@@ -106,11 +111,13 @@ def apply_parity_phase(amps, theta, *, n: int, qubits: tuple[int, ...],
     fi = -jnp.sin(theta / 2) * sign
 
     if controls:
-        sel = _control_selector(axis_of, controls, rank, rdtype)
-        fr = 1 + sel * (fr - 1)
-        fi = sel * fi
+        ok = _ctrl_ok(num, controls).astype(rdtype)
+        fr = 1 + ok * (fr - 1)
+        fi = ok * fi
 
-    return _mul_factor(amps, shape, fr, fi)
+    re = amps[0] * fr[0] - amps[1] * fi[0]
+    im = amps[0] * fi[0] + amps[1] * fr[0]
+    return jnp.stack([re, im])
 
 
 @partial(jax.jit, static_argnames=("conj",), donate_argnums=(0,))

@@ -1,0 +1,149 @@
+"""Gate-fusion tests: quest_tpu/fusion.py.
+
+Fused circuits must agree amplitude-for-amplitude with the unfused tape on
+arbitrary gate mixes (the fusion layer is pure TPU-side optimisation; the
+reference has no analogue -- its cost model is one kernel per gate,
+QuEST_cpu_distributed.c:870-905).
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import fusion
+from quest_tpu.circuits import Circuit
+from quest_tpu.ops import init as ops_init
+
+from quest_tpu.precision import real_dtype
+
+ENV = qt.createQuESTEnv()
+
+
+def _rand_unitary(rng, dim):
+    m = rng.normal(size=(dim, dim)) + 1j * rng.normal(size=(dim, dim))
+    q, r = np.linalg.qr(m)
+    return q * (np.diagonal(r) / np.abs(np.diagonal(r)))
+
+
+def _random_gate_soup(circ, n, rng, depth=30):
+    """A mix hitting every capturable primitive family."""
+    for _ in range(depth):
+        k = rng.integers(12)
+        qs = rng.permutation(n)
+        if k == 0:
+            circ.hadamard(int(qs[0]))
+        elif k == 1:
+            circ.tGate(int(qs[0]))
+        elif k == 2:
+            circ.rotateX(int(qs[0]), float(rng.uniform(0, 6)))
+        elif k == 3:
+            circ.controlledNot(int(qs[0]), int(qs[1]))
+        elif k == 4:
+            circ.controlledPhaseShift(int(qs[0]), int(qs[1]), float(rng.uniform(0, 6)))
+        elif k == 5:
+            circ.swapGate(int(qs[0]), int(qs[1]))
+        elif k == 6:
+            circ.multiRotateZ([int(qs[0]), int(qs[1])], float(rng.uniform(0, 6)))
+        elif k == 7:
+            circ.multiRotatePauli([int(qs[0]), int(qs[1])],
+                                  [int(rng.integers(1, 4)), int(rng.integers(1, 4))],
+                                  float(rng.uniform(0, 6)))
+        elif k == 8:
+            circ.unitary(int(qs[0]), _rand_unitary(rng, 2))
+        elif k == 9:
+            circ.twoQubitUnitary(int(qs[0]), int(qs[1]), _rand_unitary(rng, 4))
+        elif k == 10:
+            circ.multiStateControlledUnitary(
+                [int(qs[0])], [int(rng.integers(2))], int(qs[1]), _rand_unitary(rng, 2))
+        else:
+            circ.sqrtSwapGate(int(qs[0]), int(qs[1]))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("max_qubits", [2, 3, 5])
+def test_fused_statevector_agrees(seed, max_qubits):
+    n = 5
+    rng = np.random.default_rng(seed)
+    circ = Circuit(n)
+    _random_gate_soup(circ, n, rng)
+    fz = circ.fused(max_qubits=max_qubits)
+
+    mk = lambda: ops_init.init_debug(1 << n, real_dtype())
+    ref = np.asarray(circ.as_fn()(mk()))
+    got = np.asarray(fz.as_fn()(mk()))
+    np.testing.assert_allclose(got, ref, atol=1e-10)
+
+
+def test_fused_density_with_barriers():
+    """Decoherence entries act as barriers and the density shadow op is
+    applied exactly once per fused block."""
+    n = 3
+    rng = np.random.default_rng(7)
+    circ = Circuit(n, is_density_matrix=True)
+    circ.hadamard(0)
+    circ.controlledNot(0, 1)
+    circ.mixDephasing(1, 0.2)          # barrier: fails statevec capture
+    circ.rotateY(2, 0.9)
+    circ.mixDepolarising(0, 0.1)       # barrier
+    circ.tGate(0)
+    circ.controlledPhaseFlip(0, 2)
+    fz = circ.fused(max_qubits=3)
+
+    mk = lambda: ops_init.density_init_plus(1 << (2 * n), real_dtype())
+    ref = np.asarray(circ.as_fn()(mk()))
+    got = np.asarray(fz.as_fn()(mk()))
+    np.testing.assert_allclose(got, ref, atol=1e-10)
+
+
+def test_plan_counts_and_diagonal_blocks():
+    n = 4
+    circ = Circuit(n)
+    circ.tGate(0)
+    circ.rotateZ(1, 0.5)
+    circ.controlledPhaseShift(0, 1, 0.3)   # stays diagonal
+    circ.hadamard(2)                        # dense block
+    p = fusion.plan(tuple(circ._tape), n, real_dtype(), max_qubits=2)
+    assert p.num_fused_gates == 4 and p.num_barriers == 0
+    kinds = [type(it).__name__ for it in p.items]
+    assert kinds == ["DiagBlock", "FusedBlock"]
+
+
+def test_wide_diagonal_fuses_wide_dense_passes_through():
+    n = 6
+    circ = Circuit(n)
+    circ.hadamard(0)
+    circ.multiRotateZ(list(range(n)), 0.4)     # diagonal: fuses despite span 6
+    circ.multiQubitNot([0, n - 1])             # dense span 6 > max: barrier
+    circ.hadamard(0)
+    fz = circ.fused(max_qubits=3)
+    p = fusion.plan(tuple(circ._tape), n, real_dtype(), max_qubits=3)
+    assert p.num_barriers == 1
+    mk = lambda: ops_init.init_debug(1 << n, real_dtype())
+    np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
+                               np.asarray(circ.as_fn()(mk())), atol=1e-10)
+
+
+def test_dense_blocks_are_contiguous_windows():
+    n = 8
+    circ = Circuit(n)
+    circ.hadamard(1)
+    circ.controlledNot(1, 3)                   # window 1..3
+    circ.controlledPhaseFlip(0, 7)             # scattered but diagonal
+    p = fusion.plan(tuple(circ._tape), n, real_dtype(), max_qubits=4)
+    for it in p.items:
+        if isinstance(it, fusion.FusedBlock):
+            assert it.qubits == tuple(range(it.qubits[0], it.qubits[-1] + 1))
+    mk = lambda: ops_init.init_debug(1 << n, real_dtype())
+    fz = circ.fused(max_qubits=4)
+    np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
+                               np.asarray(circ.as_fn()(mk())), atol=1e-10)
+
+
+def test_fused_runs_on_qureg():
+    qureg = qt.createQureg(4, ENV)
+    qt.initPlusState(qureg)
+    circ = Circuit(4)
+    circ.hadamard(0)
+    circ.controlledNot(0, 1)
+    circ.fused().run(qureg)
+    assert abs(qt.calcTotalProb(qureg) - 1.0) < 1e-10
