@@ -1,0 +1,131 @@
+"""Checkpoint / resume: durable snapshots of registers and RNG state.
+
+The reference has no built-in checkpointing (SURVEY.md section 5); its
+primitives for rolling your own are ``reportState`` (CSV dump of the local
+chunk, QuEST_common.c:219-231) and ``initStateFromAmps``/``setAmps``
+(QuEST.c:157-162). This module provides both:
+
+- :func:`saveQureg` / :func:`loadQureg` -- binary snapshots (npz + JSON
+  metadata) that round-trip the full register, including density matrices,
+  precision, and the environment's PRNG stream position, and re-place the
+  amplitudes with the environment's sharding on load (the orbax-style
+  sharded-checkpoint superset SURVEY.md calls for; orbax itself is
+  overkill for a single logical array per register).
+- :func:`writeStateToCSV` -- the reference's ``reportState`` file format
+  (one "re, im" row per amplitude, state_rank_0.csv) for interop.
+
+Loads validate shape/type metadata before touching the register, so a
+corrupt or mismatched snapshot raises QuESTError and leaves state intact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from .environment import QuESTEnv
+from .registers import Qureg, createQureg, createDensityQureg
+from .validation import QuESTError
+
+__all__ = ["saveQureg", "loadQureg", "writeStateToCSV", "saveSeeds", "loadSeeds"]
+
+_META_NAME = "qureg.json"
+_AMPS_NAME = "amps.npz"
+
+
+def saveQureg(qureg: Qureg, directory: str) -> None:
+    """Snapshot ``qureg`` (amplitudes + structure + env RNG position) into
+    ``directory`` (created if needed). Atomic per-file: metadata is written
+    last, so a partial save is never loadable."""
+    os.makedirs(directory, exist_ok=True)
+    host = np.asarray(qureg.amps)  # device -> host, any sharding
+    np.savez_compressed(os.path.join(directory, _AMPS_NAME), amps=host)
+    meta = {
+        "format": 1,
+        "num_qubits_represented": qureg.num_qubits_represented,
+        "is_density_matrix": qureg.is_density_matrix,
+        "dtype": str(np.dtype(qureg.dtype)),
+        "num_amps_total": qureg.num_amps_total,
+        "seeds": list(qureg.env.seeds) if qureg.env is not None else [],
+        "rng_state": _rng_state_json(qureg.env),
+    }
+    tmp = os.path.join(directory, _META_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(directory, _META_NAME))
+
+
+def loadQureg(directory: str, env: QuESTEnv) -> Qureg:
+    """Recreate a register from :func:`saveQureg` output, sharded per
+    ``env`` (the snapshot's own sharding is irrelevant -- layout is an
+    execution property, not a state property). Restores ``env``'s RNG
+    stream so measurement sequences resume deterministically."""
+    meta_path = os.path.join(directory, _META_NAME)
+    if not os.path.exists(meta_path):
+        raise QuESTError(f"no checkpoint at {directory!r}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    if meta.get("format") != 1:
+        raise QuESTError(f"unsupported checkpoint format {meta.get('format')!r}")
+
+    with np.load(os.path.join(directory, _AMPS_NAME)) as z:
+        host = z["amps"]
+    expect = (2, meta["num_amps_total"])
+    if host.shape != expect:
+        raise QuESTError(
+            f"checkpoint amplitude shape {host.shape} != metadata {expect}")
+
+    n = meta["num_qubits_represented"]
+    make = createDensityQureg if meta["is_density_matrix"] else createQureg
+    qureg = make(n, env)
+    sharding = env.sharding(meta["num_amps_total"])
+    arr = jax.device_put(host.astype(meta["dtype"]), sharding)
+    qureg.put(arr)
+
+    env.seeds = list(meta.get("seeds", []))
+    _restore_rng(env, meta.get("rng_state"))
+    return qureg
+
+
+def writeStateToCSV(qureg: Qureg, filename: str | None = None) -> str:
+    """The reference's reportState format (QuEST_common.c:219-231): a
+    ``state_rank_0.csv`` with header and one "re, im" row per amplitude."""
+    filename = filename or "state_rank_0.csv"
+    host = np.asarray(qureg.amps)
+    with open(filename, "w") as f:
+        f.write("real, imag\n")
+        for k in range(host.shape[1]):
+            f.write(f"{host[0, k]}, {host[1, k]}\n")
+    return filename
+
+
+def saveSeeds(env: QuESTEnv, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump({"seeds": list(env.seeds), "rng_state": _rng_state_json(env)}, f)
+
+
+def loadSeeds(env: QuESTEnv, path: str) -> None:
+    with open(path) as f:
+        data = json.load(f)
+    env.seeds = list(data.get("seeds", []))
+    _restore_rng(env, data.get("rng_state"))
+
+
+def _rng_state_json(env: QuESTEnv | None):
+    if env is None or env.rng is None:
+        return None
+    name, keys, pos, has_gauss, cached = env.rng.get_state()
+    return {"name": name, "keys": np.asarray(keys).tolist(), "pos": int(pos),
+            "has_gauss": int(has_gauss), "cached": float(cached)}
+
+
+def _restore_rng(env: QuESTEnv, state) -> None:
+    if state is None or env.rng is None:
+        return
+    env.rng.set_state((state["name"],
+                       np.asarray(state["keys"], dtype=np.uint32),
+                       int(state["pos"]), int(state["has_gauss"]),
+                       float(state["cached"])))

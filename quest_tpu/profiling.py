@@ -1,0 +1,112 @@
+"""Profiling / tracing: the observability layer the reference lacks.
+
+The reference ships no timers, counters, or trace hooks (SURVEY.md section 5
+-- its only introspection is reportQuregParams and the QASM log). On TPU the
+right tool is the XLA profiler; this module packages it plus lightweight
+host-side op accounting so users can see where a circuit spends its time
+without leaving the QuEST-style API.
+
+- :func:`trace` -- context manager around ``jax.profiler`` producing a
+  Perfetto/TensorBoard trace directory.
+- :class:`OpStats` / :func:`instrument` -- count and wall-time every L5 API
+  call on a register (eager path) or every block of a Circuit run.
+- :func:`device_memory_report` -- live HBM usage per buffer, the analogue of
+  the reference's createQureg memory documentation (QuEST.h:423-430).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import jax
+
+__all__ = ["trace", "OpStats", "instrument", "device_memory_report"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XLA device trace (view with TensorBoard or Perfetto):
+
+        with quest_tpu.profiling.trace("/tmp/qtrace"):
+            circuit.run(qureg)
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class OpStats:
+    """Host-side per-op accounting collected by :func:`instrument`."""
+    counts: dict = field(default_factory=lambda: defaultdict(int))
+    seconds: dict = field(default_factory=lambda: defaultdict(float))
+
+    def report(self) -> str:
+        lines = ["op                              calls      host-seconds"]
+        for name in sorted(self.seconds, key=self.seconds.get, reverse=True):
+            lines.append(f"{name:30s} {self.counts[name]:6d} {self.seconds[name]:16.4f}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def instrument(stats: OpStats | None = None):
+    """Wrap every public gate/operator call with count + wall-time recording.
+
+    Host-side wall time includes dispatch but not necessarily device drain
+    (JAX is async); use :func:`trace` for true device timelines. Yields the
+    OpStats, restoring the un-instrumented functions on exit."""
+    import quest_tpu as qt
+
+    stats = stats or OpStats()
+    wrapped = {}
+
+    def make(name, fn):
+        def timed(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                stats.counts[name] += 1
+                stats.seconds[name] += time.perf_counter() - t0
+        timed.__name__ = name
+        return timed
+
+    from . import gates, operators, decoherence, state_init, calculations
+    modules = [gates, operators, decoherence, state_init, calculations]
+    try:
+        for mod in modules:
+            for name in getattr(mod, "__all__", []):
+                fn = getattr(mod, name, None)
+                if callable(fn):
+                    wrapped[(mod, name)] = fn
+                    timed = make(name, fn)
+                    setattr(mod, name, timed)
+                    if getattr(qt, name, None) is fn:
+                        setattr(qt, name, timed)
+        yield stats
+    finally:
+        for (mod, name), fn in wrapped.items():
+            setattr(mod, name, fn)
+            if hasattr(qt, name):
+                setattr(qt, name, fn)
+
+
+def device_memory_report(device=None) -> str:
+    """Per-buffer live HBM usage on ``device`` (default: first device)."""
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return f"{device.device_kind}: memory stats unavailable"
+    used = stats.get("bytes_in_use", 0)
+    limit = stats.get("bytes_limit", 0)
+    peak = stats.get("peak_bytes_in_use", 0)
+    return (f"{device.device_kind}: {used/2**20:.1f} MiB in use, "
+            f"peak {peak/2**20:.1f} MiB, limit {limit/2**20:.1f} MiB")
