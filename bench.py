@@ -75,9 +75,10 @@ def main() -> None:
     num_gates = len(circ)
     # Contract gate runs into contiguous-window unitaries at trace time
     # (qsim-style dense fusion, quest_tpu/fusion.py): the device sees a
-    # handful of MXU GEMMs instead of hundreds of elementwise passes. Chain
-    # block-sized executables when the program would otherwise be huge.
-    fused = circ.fused(max_qubits=5)
+    # handful of MXU GEMMs instead of hundreds of elementwise passes, and
+    # tile-local 1q/parity runs collapse further into single-HBM-pass Pallas
+    # kernels (ops/pallas_gates.py).
+    fused = circ.fused(max_qubits=5, pallas=True)
     print(f"# fused {num_gates} gates -> {len(fused)} blocks", file=sys.stderr)
     if len(fused) > 48:
         fn = fused.compiled_blocks(max_gates=24, donate=True)

@@ -38,11 +38,19 @@ _AMPS_NAME = "amps.npz"
 
 def saveQureg(qureg: Qureg, directory: str) -> None:
     """Snapshot ``qureg`` (amplitudes + structure + env RNG position) into
-    ``directory`` (created if needed). Atomic per-file: metadata is written
-    last, so a partial save is never loadable."""
+    ``directory`` (created if needed). A partial save is never loadable:
+    any existing metadata is invalidated first, the amplitude payload is
+    written via rename, and fresh metadata is written (also via rename)
+    only after the payload is on disk."""
     os.makedirs(directory, exist_ok=True)
+    meta_path = os.path.join(directory, _META_NAME)
+    if os.path.exists(meta_path):
+        os.unlink(meta_path)  # a crash mid-overwrite must not look loadable
     host = np.asarray(qureg.amps)  # device -> host, any sharding
-    np.savez_compressed(os.path.join(directory, _AMPS_NAME), amps=host)
+    amps_tmp = os.path.join(directory, _AMPS_NAME + ".tmp")
+    with open(amps_tmp, "wb") as f:
+        np.savez_compressed(f, amps=host)
+    os.replace(amps_tmp, os.path.join(directory, _AMPS_NAME))
     meta = {
         "format": 1,
         "num_qubits_represented": qureg.num_qubits_represented,
@@ -66,13 +74,19 @@ def loadQureg(directory: str, env: QuESTEnv) -> Qureg:
     meta_path = os.path.join(directory, _META_NAME)
     if not os.path.exists(meta_path):
         raise QuESTError(f"no checkpoint at {directory!r}")
-    with open(meta_path) as f:
-        meta = json.load(f)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise QuESTError(f"unreadable checkpoint metadata: {e}") from e
     if meta.get("format") != 1:
         raise QuESTError(f"unsupported checkpoint format {meta.get('format')!r}")
 
-    with np.load(os.path.join(directory, _AMPS_NAME)) as z:
-        host = z["amps"]
+    try:
+        with np.load(os.path.join(directory, _AMPS_NAME)) as z:
+            host = z["amps"]
+    except Exception as e:
+        raise QuESTError(f"unreadable checkpoint payload: {e}") from e
     expect = (2, meta["num_amps_total"])
     if host.shape != expect:
         raise QuESTError(

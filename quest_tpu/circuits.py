@@ -160,22 +160,35 @@ class Circuit:
             self._compiled[key] = fn
         return self._compiled[key]
 
-    def fused(self, max_qubits: int = 5, dtype=None) -> "Circuit":
+    def fused(self, max_qubits: int = 5, dtype=None,
+              pallas: bool = False) -> "Circuit":
         """A new Circuit with runs of gates contracted into ``max_qubits``-
         qubit unitaries at trace time (see :mod:`quest_tpu.fusion`).
 
         Semantics-preserving for arbitrary tapes: entries that cannot be
         captured as gate primitives (decoherence, phase functions, inits)
         pass through unchanged and act as fusion barriers.
+
+        ``pallas=True`` (state-vector tapes only) additionally routes runs
+        of tile-local 1-qubit gates and parity phases through the fused
+        Pallas kernel (ops.pallas_gates): one HBM pass per run instead of
+        one GEMM pass per dense block.
         """
         import numpy as np
 
         from . import fusion
         from .precision import real_dtype
 
+        tile_bits = None
+        if pallas and not self.is_density_matrix:
+            from .ops.pallas_gates import LANE_BITS, local_qubits
+            # below 2^LANE_BITS amplitudes there is no lane tile to build;
+            # the ordinary fusion path handles such registers
+            if self.num_qubits > LANE_BITS:
+                tile_bits = local_qubits(self.num_qubits)
         p = fusion.plan(tuple(self._tape), self.num_qubits,
                         np.dtype(dtype) if dtype else real_dtype(),
-                        max_qubits=max_qubits)
+                        max_qubits=max_qubits, pallas_tile_bits=tile_bits)
         out = Circuit(self.num_qubits, self.is_density_matrix)
         out._tape = fusion.as_tape(p)
         return out

@@ -289,12 +289,42 @@ class FusePlan:
     num_barriers: int = 0
 
 
+@dataclass
+class PallasRun:
+    """A run of tile-local 1-qubit matrices / parity phases executed in ONE
+    Pallas HBM pass (ops.pallas_gates.fused_local_run). Gate targets must be
+    below ``tile_bits``; controls and parity members may be any qubit."""
+    ops: tuple
+    tile_bits: int
+
+
+def _pallas_op(ev: GateEvent, tile_bits: int):
+    """Lower a captured event to a pallas_gates op, or None if unsupported."""
+    from .ops.pallas_gates import HashableMatrix
+
+    if ev.kind == "parity":
+        return ("parity", ev.targets, ev.controls, float(ev.theta))
+    if len(ev.targets) != 1 or ev.targets[0] >= tile_bits:
+        return None
+    q = ev.targets[0]
+    states = tuple(ev.states) if ev.states else (1,) * len(ev.controls)
+    if ev.kind == "matrix":
+        m = ev.matrix
+    elif ev.kind == "diag":
+        m = np.diag(ev.diag)
+    elif ev.kind == "x":
+        m = np.array([[0, 1], [1, 0]], dtype=complex)
+    else:
+        return None
+    return ("matrix", q, tuple(ev.controls), states, HashableMatrix(m))
+
+
 def _window(qubits) -> tuple:
     return tuple(range(min(qubits), max(qubits) + 1))
 
 
 def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
-         max_diag_qubits: int = 12) -> FusePlan:
+         max_diag_qubits: int = 12, pallas_tile_bits: int | None = None) -> FusePlan:
     """Greedy left-to-right fusion of a Circuit tape.
 
     Dense events merge while the combined contiguous window spans at most
@@ -305,12 +335,18 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
     """
     out = FusePlan()
     cur = None  # None | FusedBlock | DiagBlock (mutable accumulators)
+    pal: list = []  # pending pallas ops (pallas_tile_bits mode only)
 
     def flush():
         nonlocal cur
         if cur is not None:
             out.items.append(cur)
         cur = None
+
+    def flush_pal():
+        if pal:
+            out.items.append(PallasRun(tuple(pal), pallas_tile_bits))
+            pal.clear()
 
     def add_dense(ev):
         nonlocal cur
@@ -359,18 +395,39 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
             else (len(_window(ev.support)) <= max_qubits)
             for ev in events)
         if not fusible:
+            flush_pal()
             flush()
             out.items.append((fn, args, kwargs))
             out.num_barriers += 1
             continue
         for ev in events:
+            if pallas_tile_bits is not None:
+                pop = _pallas_op(ev, pallas_tile_bits)
+                if pop is not None:
+                    flush()  # preserve order vs pending dense/diag work
+                    pal.append(pop)
+                    out.num_fused_gates += 1
+                    continue
+                flush_pal()
             if _event_is_diag(ev):
                 add_diag(ev)
             else:
                 add_dense(ev)
             out.num_fused_gates += 1
+    flush_pal()
     flush()
     return out
+
+
+def _apply_pallas_run(qureg, ops: tuple, tile_bits: int) -> None:
+    """Tape-entry wrapper for a PallasRun (state-vector registers only; the
+    density shadow would target qubits >= tile_bits, which the kernel cannot
+    pair -- density tapes never produce PallasRuns, see Circuit.fused)."""
+    from .ops.pallas_gates import fused_local_run
+
+    assert not qureg.is_density_matrix
+    qureg.put(fused_local_run(qureg.amps, n=qureg.num_qubits_in_state_vec,
+                              ops=ops))
 
 
 def as_tape(p: FusePlan) -> list:
@@ -383,6 +440,8 @@ def as_tape(p: FusePlan) -> list:
             entries.append((G._apply_gate_diag, (item.diag, item.qubits), {}))
         elif isinstance(item, FusedBlock):
             entries.append((G._apply_gate_matrix, (item.matrix, item.qubits), {}))
+        elif isinstance(item, PallasRun):
+            entries.append((_apply_pallas_run, (item.ops, item.tile_bits), {}))
         else:
             entries.append(item)
     return entries

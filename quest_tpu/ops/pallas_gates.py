@@ -1,0 +1,243 @@
+"""Pallas TPU kernel: a fused run of gates in ONE pass over HBM.
+
+The hot loop of a state-vector simulator is "stream 2^n amplitudes through
+an update rule". XLA's GEMM formulation (ops.apply) pays one full HBM
+round-trip per fused block; this kernel applies an arbitrarily long run of
+single-qubit matrices, controlled gates, and parity phases in a single
+read+write of the state: each grid program pulls a (2, S, 128) planar tile
+into VMEM, applies every gate of the run in-register, and writes the tile
+back. The reference's analogous hot loops are one kernel launch per gate
+(statevec_compactUnitaryLocal, QuEST_cpu.c:1682-1739; CUDA variant
+QuEST_gpu.cu:492-554) -- fusing the run is pure TPU-side gain, the same
+bandwidth argument as the dense-fusion layer (quest_tpu/fusion.py) taken to
+its limit for the 1-qubit-dominated parts of a circuit.
+
+Geometry: the flat amplitude index is split (grid, sublane, lane) =
+(i >> (7+log2 S), (i >> 7) & (S-1), i & 127). A gate on qubit q pairs
+amplitude i with i ^ 2^q:
+
+- q < 7 (lane bits): partner = two pltpu.rolls along the lane axis,
+  selected per element by bit q of the lane index -- a VPU permute.
+- 7 <= q < 7+log2 S (sublane bits): same along the sublane axis.
+- q >= 7+log2 S (grid bits): only *diagonal* roles are supported (control
+  qubits, parity-phase members): their bit is a per-program scalar from
+  pl.program_id. Gate TARGETS on grid bits need cross-tile data and are
+  the caller's job to route elsewhere (ops.apply window GEMMs).
+
+Ops format (all matrix data static at trace time, baked into the kernel):
+
+    ("matrix", q, controls, states, M)   M: 2x2 complex ndarray, q local
+    ("parity", qubits, controls, theta)  exp(-i theta/2 Z...Z), any qubits
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE_BITS = 7          # minor dim fixed at 128 lanes
+_LANES = 1 << LANE_BITS
+#: (2, 1024, 128) f32 tile = 1 MiB; 2048 sublanes was measured to blow the
+#: 16 MiB scoped-VMEM budget once the kernel's per-gate temporaries pile up.
+_DEF_SUBLANES = 1 << 10
+
+
+def local_qubits(n: int, sublanes: int = _DEF_SUBLANES) -> int:
+    """Number of low qubits a tile holds entirely (targets must be below)."""
+    rows = 1 << max(n - LANE_BITS, 0)
+    s = min(sublanes, rows)
+    return min(n, LANE_BITS + int(math.log2(s)) if s > 1 else LANE_BITS)
+
+
+def _bit_mask(q: int, shape):
+    """Bit q of the in-tile flat index as a (S, 128) {0,1} i32 array."""
+    if q < LANE_BITS:
+        lane = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        return (lane >> q) & 1
+    sub = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    return (sub >> (q - LANE_BITS)) & 1
+
+
+def _grid_bit(q: int, tile_bits: int):
+    """Bit q of the flat index when q is a grid bit: per-program scalar."""
+    return (pl.program_id(0) >> (q - tile_bits)) & 1
+
+
+def _partner(arr, q: int):
+    """arr[i ^ 2^q] within the tile via two circular rolls + per-bit select."""
+    if q < LANE_BITS:
+        m, axis = 1 << q, 1
+    else:
+        m, axis = 1 << (q - LANE_BITS), 0
+    size = arr.shape[axis]
+    up = pltpu.roll(arr, size - m, axis)   # up[i] = arr[i + m] (shift >= 0 req)
+    dn = pltpu.roll(arr, m, axis)          # dn[i] = arr[i - m]
+    bit = _bit_mask(q, arr.shape)
+    return jnp.where(bit == 0, up, dn)
+
+
+def _ctrl_scalar_and_mask(controls, states, tile_bits, shape):
+    """(static_ok, elementwise {0,1} mask or None) for a control set."""
+    states = states if states else (1,) * len(controls)
+    mask = None
+    scalar = None
+    for c, st in zip(controls, states):
+        if c >= tile_bits:
+            b = _grid_bit(c, tile_bits)
+            ok = jnp.where(b == st, 1, 0)
+            scalar = ok if scalar is None else scalar * ok
+        else:
+            b = _bit_mask(c, shape)
+            ok = jnp.where(b == st, 1, 0)
+            mask = ok if mask is None else mask * ok
+    return scalar, mask
+
+
+def _make_kernel(ops, s_bits, tile_bits, dtype):
+    one = np.array(1, dtype)
+
+    def kernel(x_ref, o_ref):
+        xr = x_ref[0]
+        xi = x_ref[1]
+        shape = xr.shape
+
+        for op in ops:
+            if op[0] == "matrix":
+                _, q, controls, states, M = op
+                m00, m01, m10, m11 = (complex(M[0, 0]), complex(M[0, 1]),
+                                      complex(M[1, 0]), complex(M[1, 1]))
+                bit = _bit_mask(q, shape)
+                pr = _partner(xr, q)
+                pi = _partner(xi, q)
+                # coefficient planes: self = m00/m11, pair = m01/m10 by bit q
+                csr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
+                csi = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
+                cpr = jnp.where(bit == 0, dtype.type(m01.real), dtype.type(m10.real))
+                cpi = jnp.where(bit == 0, dtype.type(m01.imag), dtype.type(m10.imag))
+                nr = csr * xr - csi * xi + cpr * pr - cpi * pi
+                ni = csr * xi + csi * xr + cpr * pi + cpi * pr
+                scalar, mask = _ctrl_scalar_and_mask(
+                    controls, states, tile_bits, shape)
+                if mask is not None:
+                    keep = mask.astype(dtype)
+                    nr = keep * nr + (one - keep) * xr
+                    ni = keep * ni + (one - keep) * xi
+                if scalar is not None:
+                    keep = scalar.astype(dtype)
+                    nr = keep * nr + (one - keep) * xr
+                    ni = keep * ni + (one - keep) * xi
+                xr, xi = nr, ni
+
+            elif op[0] == "parity":
+                _, qubits, controls, theta = op
+                sign_scalar = jnp.array(1, jnp.int32)
+                par = None
+                for q in qubits:
+                    if q >= tile_bits:
+                        gb = _grid_bit(q, tile_bits)
+                        sign_scalar = sign_scalar * (1 - 2 * gb)
+                    else:
+                        b = _bit_mask(q, shape)
+                        par = b if par is None else par ^ b
+                sign = sign_scalar.astype(dtype)
+                if par is not None:
+                    sign = sign * (1 - 2 * par).astype(dtype)
+                c = dtype.type(math.cos(theta / 2))
+                s = dtype.type(math.sin(theta / 2))
+                fr = c
+                fi = -s * sign
+                nr = xr * fr - xi * fi
+                ni = xr * fi + xi * fr
+                scalar, mask = _ctrl_scalar_and_mask(
+                    controls, (), tile_bits, shape)
+                if mask is not None:
+                    keep = mask.astype(dtype)
+                    nr = keep * nr + (one - keep) * xr
+                    ni = keep * ni + (one - keep) * xi
+                if scalar is not None:
+                    keep = scalar.astype(dtype)
+                    nr = keep * nr + (one - keep) * xr
+                    ni = keep * ni + (one - keep) * xi
+                xr, xi = nr, ni
+
+            else:  # pragma: no cover
+                raise ValueError(f"unknown pallas op {op[0]!r}")
+
+        o_ref[0] = xr
+        o_ref[1] = xi
+
+    return kernel
+
+
+def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
+                    interpret: bool | None = None):
+    """Apply ``ops`` (see module doc) to the planar (2, 2^n) state in one
+    fused Pallas pass. Every matrix target must satisfy
+    ``q < local_qubits(n, sublanes)``; parity members and controls may be
+    any qubit. ``ops`` is hashable (tuples + HashableMatrix wrappers).
+    On non-TPU backends the kernel runs in the Pallas interpreter (CI)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if amps.shape[-1] < _LANES:
+        raise ValueError(
+            f"state has {amps.shape[-1]} amplitudes < one {_LANES}-lane tile; "
+            f"registers below {LANE_BITS + 1} qubits take the ordinary path")
+    if any(o[0] == "matrix" and o[1] >= local_qubits(n, sublanes) for o in ops):
+        raise ValueError(
+            f"matrix target >= local_qubits({n}, {sublanes}) = "
+            f"{local_qubits(n, sublanes)}; route wide targets via ops.apply")
+    return _fused_local_run(amps, n=n, ops=ops, sublanes=sublanes,
+                            interpret=bool(interpret))
+
+
+@partial(jax.jit, static_argnames=("n", "ops", "sublanes", "interpret"),
+         donate_argnums=(0,))
+def _fused_local_run(amps, *, n: int, ops: tuple, sublanes: int,
+                     interpret: bool):
+    num = amps.shape[-1]
+    rows = max(num >> LANE_BITS, 1)
+    s = min(sublanes, rows)
+    s_bits = int(math.log2(s)) if s > 1 else 0
+    tile_bits = LANE_BITS + s_bits
+    grid = rows // s
+
+    ops_r = tuple((o[0], o[1], o[2], o[3], np.asarray(o[4].arr if hasattr(o[4], "arr") else o[4]))
+                  if o[0] == "matrix" else o for o in ops)
+    kernel = _make_kernel(ops_r, s_bits, tile_bits, np.dtype(amps.dtype))
+
+    x = amps.reshape(2, rows, _LANES)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x)
+    return out.reshape(2, -1)
+
+
+class HashableMatrix:
+    """Immutable ndarray wrapper usable inside the static ``ops`` tuple."""
+
+    def __init__(self, arr):
+        self.arr = np.asarray(arr, dtype=complex)
+        self.arr.setflags(write=False)
+        self._key = self.arr.tobytes()
+
+    def __getitem__(self, idx):
+        return self.arr[idx]
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, HashableMatrix) and self._key == other._key

@@ -37,14 +37,24 @@ def test_save_load_density_and_rng_resume(tmp_path):
     ckpt = str(tmp_path / "ckd")
     qt.saveQureg(d, ckpt)
 
+    def rng_dependent_draws(e):
+        # |+> measurements: outcome sequence depends on the RNG stream
+        outs = []
+        for _ in range(12):
+            q = qt.createQureg(1, e)
+            qt.hadamard(q, 0)
+            outs.append(qt.measure(q, 0))
+        return outs
+
     # draw after saving; a resumed env must reproduce the same draws
-    seq_a = [qt.measure(qt.createQureg(2, env), 0) for _ in range(8)]
+    seq_a = rng_dependent_draws(env)
+    assert len(set(seq_a)) == 2, "draws should be random"
 
     env2 = qt.createQuESTEnv()
     d2 = qt.loadQureg(ckpt, env2)
     assert d2.is_density_matrix
     np.testing.assert_allclose(np.asarray(d2.amps), np.asarray(d.amps), atol=0)
-    seq_b = [qt.measure(qt.createQureg(2, env2), 0) for _ in range(8)]
+    seq_b = rng_dependent_draws(env2)
     assert seq_a == seq_b  # RNG stream position restored
 
 
@@ -60,6 +70,11 @@ def test_load_rejects_corrupt_metadata(tmp_path):
         qt.loadQureg(ckpt, ENV)
     with pytest.raises(QuESTError):
         qt.loadQureg(str(tmp_path / "nowhere"), ENV)
+    # truncated payload (crash mid-write) must raise QuESTError, not escape
+    with open(os.path.join(ckpt, "amps.npz"), "wb") as f:
+        f.write(b"PK\x03\x04 truncated")
+    with pytest.raises(QuESTError):
+        qt.loadQureg(ckpt, ENV)
 
 
 def test_write_state_csv_matches_reference_format(tmp_path):

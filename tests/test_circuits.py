@@ -11,6 +11,8 @@ import pytest
 
 import quest_tpu as qt
 
+from .helpers import TOL
+
 ENV = qt.createQuESTEnv()
 
 
@@ -58,7 +60,7 @@ def test_circuit_matches_eager(density):
     circ.run(q_tape)
 
     np.testing.assert_allclose(qt.get_np(q_tape), qt.get_np(q_eager),
-                               atol=1e-12)
+                               atol=TOL)
 
 
 def test_circuit_reuse_and_decoherence():
@@ -72,14 +74,14 @@ def test_circuit_reuse_and_decoherence():
         q = qt.createDensityQureg(n, ENV)
         qt.initZeroState(q)
         circ.run(q)
-        assert abs(qt.calcTotalProb(q) - 1.0) < 1e-12
+        assert abs(qt.calcTotalProb(q) - 1.0) < TOL
 
     q2 = qt.createDensityQureg(n, ENV)
     qt.initZeroState(q2)
     qt.hadamard(q2, 0)
     qt.mixDephasing(q2, 0, 0.3)
     qt.mixDepolarising(q2, 1, 0.2)
-    np.testing.assert_allclose(qt.get_np(q), qt.get_np(q2), atol=1e-12)
+    np.testing.assert_allclose(qt.get_np(q), qt.get_np(q2), atol=TOL)
 
 
 def test_circuit_init_on_tape():
@@ -89,7 +91,7 @@ def test_circuit_init_on_tape():
     q = qt.createQureg(2, ENV)
     circ.run(q)
     got = qt.get_np(q)
-    np.testing.assert_allclose(got, np.array([0.5, 0.5, -0.5, -0.5]), atol=1e-12)
+    np.testing.assert_allclose(got, np.array([0.5, 0.5, -0.5, -0.5]), atol=TOL)
 
 
 def test_circuit_rejects_mismatched_qureg():

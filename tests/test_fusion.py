@@ -16,6 +16,8 @@ from quest_tpu.ops import init as ops_init
 
 from quest_tpu.precision import real_dtype
 
+from .helpers import TOL
+
 ENV = qt.createQuESTEnv()
 
 
@@ -71,7 +73,7 @@ def test_fused_statevector_agrees(seed, max_qubits):
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
     ref = np.asarray(circ.as_fn()(mk()))
     got = np.asarray(fz.as_fn()(mk()))
-    np.testing.assert_allclose(got, ref, atol=1e-10)
+    np.testing.assert_allclose(got, ref, atol=TOL)
 
 
 def test_fused_density_with_barriers():
@@ -92,7 +94,7 @@ def test_fused_density_with_barriers():
     mk = lambda: ops_init.density_init_plus(1 << (2 * n), real_dtype())
     ref = np.asarray(circ.as_fn()(mk()))
     got = np.asarray(fz.as_fn()(mk()))
-    np.testing.assert_allclose(got, ref, atol=1e-10)
+    np.testing.assert_allclose(got, ref, atol=TOL)
 
 
 def test_plan_counts_and_diagonal_blocks():
@@ -120,7 +122,7 @@ def test_wide_diagonal_fuses_wide_dense_passes_through():
     assert p.num_barriers == 1
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
     np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=1e-10)
+                               np.asarray(circ.as_fn()(mk())), atol=TOL)
 
 
 def test_dense_blocks_are_contiguous_windows():
@@ -136,7 +138,7 @@ def test_dense_blocks_are_contiguous_windows():
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
     fz = circ.fused(max_qubits=4)
     np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=1e-10)
+                               np.asarray(circ.as_fn()(mk())), atol=TOL)
 
 
 def test_fused_runs_on_qureg():
@@ -146,4 +148,4 @@ def test_fused_runs_on_qureg():
     circ.hadamard(0)
     circ.controlledNot(0, 1)
     circ.fused().run(qureg)
-    assert abs(qt.calcTotalProb(qureg) - 1.0) < 1e-10
+    assert abs(qt.calcTotalProb(qureg) - 1.0) < TOL

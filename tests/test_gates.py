@@ -10,7 +10,7 @@ import pytest
 import quest_tpu as qt
 
 from . import oracle
-from .helpers import (NUM_QUBITS, assert_density_equal, assert_statevec_equal,
+from .helpers import (TOL, NUM_QUBITS, assert_density_equal, assert_statevec_equal,
                       debug_state_and_ref, set_density, set_statevec)
 
 ENV = qt.createQuESTEnv()
@@ -52,14 +52,14 @@ def test_collapseToOutcome(qureg, target, outcome):
         set_density(qureg, rho)
         ref, prob = _collapsed_rho(rho, target, outcome)
         got = qt.collapseToOutcome(qureg, target, outcome)
-        assert got == pytest.approx(prob, abs=1e-10)
+        assert got == pytest.approx(prob, abs=TOL)
         assert_density_equal(qureg, ref)
     else:
         vec = oracle.random_statevec(NUM_QUBITS, rng)
         set_statevec(qureg, vec)
         ref, prob = _collapsed_vec(vec, target, outcome)
         got = qt.collapseToOutcome(qureg, target, outcome)
-        assert got == pytest.approx(prob, abs=1e-10)
+        assert got == pytest.approx(prob, abs=TOL)
         assert_statevec_equal(qureg, ref)
 
 
@@ -134,9 +134,9 @@ def test_measure_collapses_state(qureg):
     outcome, prob = qt.measureWithStats(qureg, 1)
     if qureg.is_density_matrix:
         exp_rho, exp_prob = _collapsed_rho(ref, 1, outcome)
-        assert prob == pytest.approx(exp_prob, abs=1e-9)
-        assert_density_equal(qureg, exp_rho, tol=1e-8)
+        assert prob == pytest.approx(exp_prob, abs=TOL)
+        assert_density_equal(qureg, exp_rho, tol=TOL)
     else:
         exp_vec, exp_prob = _collapsed_vec(ref, 1, outcome)
-        assert prob == pytest.approx(exp_prob, abs=1e-9)
-        assert_statevec_equal(qureg, exp_vec, tol=1e-8)
+        assert prob == pytest.approx(exp_prob, abs=TOL)
+        assert_statevec_equal(qureg, exp_vec, tol=TOL)

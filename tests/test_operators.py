@@ -16,7 +16,7 @@ import quest_tpu as qt
 from quest_tpu import bitEncoding, phaseFunc
 
 from . import oracle
-from .helpers import (NUM_QUBITS, assert_density_equal, assert_statevec_equal,
+from .helpers import (TOL, NUM_QUBITS, assert_density_equal, assert_statevec_equal,
                       debug_state_and_ref, get_density, get_statevec)
 
 ENV = qt.createQuESTEnv()
@@ -380,7 +380,7 @@ def test_calcExpecDiagonalOp_density(density):
     rho = debug_state_and_ref(density)
     got = qt.calcExpecDiagonalOp(density, op)
     ref = np.trace(np.diag(re + 1j * im) @ rho)
-    assert got == pytest.approx(ref, abs=1e-9)
+    assert got == pytest.approx(ref, abs=TOL * 100)
     qt.destroyDiagonalOp(op, ENV)
 
 

@@ -1,0 +1,108 @@
+"""Pallas fused-gate-run kernel tests (quest_tpu/ops/pallas_gates.py).
+
+On the CPU CI backend the kernel runs in the Pallas interpreter; the same
+code compiles via Mosaic on a real TPU (exercised by bench.py and the
+driver's compile check). Correctness oracle: the ordinary engine path.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import fusion
+from quest_tpu.circuits import Circuit
+from quest_tpu.ops import init as ops_init
+from quest_tpu.ops import pallas_gates as PG
+from quest_tpu.precision import real_dtype
+
+from .helpers import TOL
+
+H = np.array([[1, 1], [1, -1]]) / np.sqrt(2)
+X = np.array([[0, 1], [1, 0]], dtype=complex)
+
+
+def _rz(th):
+    return np.diag([np.exp(-0.5j * th), np.exp(0.5j * th)])
+
+
+def test_kernel_matches_engine_all_bit_classes():
+    """Targets on lane bits, sublane bits; controls and parity members on
+    lane/sublane/grid bits."""
+    n = 10
+    ops = (
+        ("matrix", 0, (), (), PG.HashableMatrix(H)),
+        ("matrix", 3, (), (), PG.HashableMatrix(_rz(0.7))),
+        ("matrix", 1, (9,), (1,), PG.HashableMatrix(X)),   # grid-bit control
+        ("matrix", 8, (2,), (1,), PG.HashableMatrix(X)),   # sublane target
+        ("matrix", 5, (7,), (0,), PG.HashableMatrix(H)),   # control-on-zero
+        ("parity", (0, 9), (), 0.77),                      # grid-bit parity
+        ("matrix", 7, (), (), PG.HashableMatrix(H)),
+    )
+    amps = ops_init.init_debug(1 << n, real_dtype())
+    got = PG.fused_local_run(amps, n=n, ops=ops, sublanes=4)
+
+    circ = Circuit(n)
+    circ.hadamard(0)
+    circ.rotateZ(3, 0.7)
+    circ.controlledNot(9, 1)
+    circ.controlledNot(2, 8)
+    circ.multiStateControlledUnitary([7], [0], 5, H)
+    circ.multiRotateZ([0, 9], 0.77)
+    circ.hadamard(7)
+    ref = circ.as_fn()(ops_init.init_debug(1 << n, real_dtype()))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL)
+
+
+def test_kernel_rejects_grid_bit_target():
+    amps = ops_init.init_debug(1 << 10, real_dtype())
+    ops = (("matrix", 9, (), (), PG.HashableMatrix(H)),)
+    with pytest.raises(ValueError, match="local_qubits"):
+        PG.fused_local_run(amps, n=10, ops=ops, sublanes=4)
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_pallas_integrated_fusion_agrees(seed):
+    from __graft_entry__ import _random_layers
+
+    n = 9
+    circ = Circuit(n)
+    _random_layers(circ, n, depth=3, seed=seed)
+    fz = circ.fused(max_qubits=5, pallas=True)
+    assert any(f.__name__ == "_apply_pallas_run" for f, _, _ in fz._tape)
+
+    mk = lambda: ops_init.init_debug(1 << n, real_dtype())
+    np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
+                               np.asarray(circ.as_fn()(mk())), atol=TOL)
+
+
+def test_density_tapes_never_use_pallas():
+    circ = Circuit(4, is_density_matrix=True)
+    circ.hadamard(0)
+    circ.controlledNot(0, 1)
+    fz = circ.fused(max_qubits=3, pallas=True)
+    assert all(f.__name__ != "_apply_pallas_run" for f, _, _ in fz._tape)
+
+
+def test_plan_orders_pallas_and_dense_blocks():
+    """A high-qubit dense gate between local gates must split the run."""
+    n = 10
+    tile_bits = PG.local_qubits(n, sublanes=4)
+    circ = Circuit(n)
+    circ.hadamard(0)
+    circ.hadamard(n - 1)   # grid-bit target: dense block
+    circ.hadamard(1)
+    p = fusion.plan(tuple(circ._tape), n, real_dtype(), max_qubits=3,
+                    pallas_tile_bits=tile_bits)
+    names = [type(it).__name__ for it in p.items]
+    assert names == ["PallasRun", "FusedBlock", "PallasRun"]
+
+
+def test_small_register_falls_back_to_ordinary_fusion():
+    circ = Circuit(6)
+    circ.hadamard(0)
+    circ.controlledNot(0, 5)
+    fz = circ.fused(max_qubits=3, pallas=True)
+    assert all(f.__name__ != "_apply_pallas_run" for f, _, _ in fz._tape)
+    mk = lambda: ops_init.init_debug(1 << 6, real_dtype())
+    np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
+                               np.asarray(circ.as_fn()(mk())), atol=TOL)

@@ -11,6 +11,8 @@ import pytest
 
 import jax
 import quest_tpu as qt
+
+from .helpers import TOL
 from quest_tpu.parallel import plan_circuit
 from quest_tpu.parallel.mesh import local_qubit_count
 
@@ -73,7 +75,7 @@ def test_explicit_matches_default(density):
     with qt.explicit_mesh(ENV.mesh):
         _build(_Eager(q_dist), n, np.random.RandomState(3))
 
-    np.testing.assert_allclose(qt.get_np(q_dist), qt.get_np(q_ref), atol=1e-12)
+    np.testing.assert_allclose(qt.get_np(q_dist), qt.get_np(q_ref), atol=TOL)
 
 
 def test_explicit_on_circuit_tape():
@@ -92,7 +94,7 @@ def test_explicit_on_circuit_tape():
     with qt.explicit_mesh(ENV.mesh):
         circ.run(q)
 
-    np.testing.assert_allclose(qt.get_np(q), qt.get_np(q_ref), atol=1e-12)
+    np.testing.assert_allclose(qt.get_np(q), qt.get_np(q_ref), atol=TOL)
     # output keeps the register's sharding across the explicit kernels
     assert len(q.amps.sharding.device_set) == ENV.mesh.size
 
@@ -135,4 +137,4 @@ def test_measurement_under_explicit_mesh():
         qt.controlledNot(q, 4, 0)
         outcome = qt.measure(q, 4)
         assert qt.measure(q, 0) == outcome  # Bell pair correlation
-    assert abs(qt.calcTotalProb(q) - 1) < 1e-10
+    assert abs(qt.calcTotalProb(q) - 1) < TOL
