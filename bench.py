@@ -47,6 +47,53 @@ def build_circuit(n: int, depth: int):
     return circ
 
 
+def bench_density(n: int, reps: int, sync) -> dict:
+    """BASELINE.json config 4: n-qubit density matrix driven through
+    mixDepolarising + mixKrausMap interleaved with unitaries."""
+    import numpy as np
+
+    import quest_tpu as qt
+    from quest_tpu.circuits import Circuit
+
+    env = qt.createQuESTEnv()
+    rho = qt.createDensityQureg(n, env)
+    qt.initPlusState(rho)
+
+    k = 1 / np.sqrt(2)
+    kraus = [np.array([[k, 0], [0, k]]), np.array([[0, k], [k, 0]])]
+    # representative channel step: unitaries + both decoherence families.
+    # Kept lean: a 14q density register is 2^28 amps and each Kraus channel
+    # lowers to several full passes, so op count drives remote-compile time.
+    circ = Circuit(n, is_density_matrix=True)
+    for q in range(4):
+        circ.hadamard(q)
+    circ.controlledNot(0, 1)
+    circ.controlledNot(2, 3)
+    circ.mixDepolarising(0, 0.05)
+    circ.mixDepolarising(n - 1, 0.05)
+    circ.mixKrausMap(1, kraus)
+    circ.mixTwoQubitDephasing(0, 1, 0.1)
+    num_ops = len(circ)
+    fn = circ.fused(max_qubits=4).compiled_blocks(max_gates=4, donate=True)
+
+    import time
+    amps = rho.amps
+    amps = fn(amps)
+    sync(amps)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        amps = fn(amps)
+    sync(amps)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": f"channel-ops/sec, {n}-qubit density matrix "
+                  f"(mixDepolarising+mixKrausMap)",
+        "value": round(num_ops * reps / dt, 2),
+        "unit": "ops/sec",
+        "vs_baseline": None,
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--qubits", type=int, default=26)
@@ -54,6 +101,10 @@ def main() -> None:
     p.add_argument("--reps", type=int, default=5)
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for CI (12 qubits, depth 2)")
+    p.add_argument("--config", choices=["statevec", "density"],
+                   default="statevec",
+                   help="statevec: random Clifford+T (BASELINE configs 1-3); "
+                        "density: 14q decoherence channel (config 4)")
     args = p.parse_args()
     if args.smoke:
         args.qubits, args.depth = 12, 2
@@ -70,6 +121,15 @@ def main() -> None:
     import jax.numpy as jnp
     from quest_tpu.ops import init as ops_init
 
+    def sync(a):
+        # forces the whole donated chain to drain (see module docstring)
+        return float(jax.device_get(a.reshape(-1)[0]))
+
+    if args.config == "density":
+        print(json.dumps(bench_density(14 if not args.smoke else 6,
+                                       args.reps, sync)))
+        return
+
     n, depth = args.qubits, args.depth
     circ = build_circuit(n, depth)
     num_gates = len(circ)
@@ -84,10 +144,6 @@ def main() -> None:
         fn = fused.compiled_blocks(max_gates=24, donate=True)
     else:
         fn = fused.compiled(donate=True)
-
-    def sync(a):
-        # forces the whole donated chain to drain (see module docstring)
-        return float(jax.device_get(a[0, 0]))
 
     t0 = time.perf_counter()
     amps = ops_init.init_classical(1 << n, jnp.dtype("float32"), 0)

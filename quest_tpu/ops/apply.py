@@ -154,19 +154,52 @@ def apply_matrix(amps, matrix, *, n: int, targets: tuple[int, ...],
     return tensor.transpose(inv).reshape(2, -1)
 
 
+#: X/swap supports spanning at most this many contiguous qubits are applied
+#: as a host-built permutation matrix through the window GEMM (layout-clean);
+#: wider spans fall back to the grouped view, whose tile padding makes it
+#: unusable on large states but fine on small ones.
+_PERM_WINDOW_MAX = 8
+
+
+def _window_perm_matrix(span_lo, span_hi, flips, cbits, states, np_dtype):
+    """Permutation matrix over the window [span_lo, span_hi]: XOR ``flips``
+    where every control bit matches its required state; identity elsewhere.
+    All-static, built host-side at trace time."""
+    import numpy as np
+    k = span_hi - span_lo + 1
+    dim = 1 << k
+    mr = np.zeros((dim, dim), dtype=np_dtype)
+    fl = 0
+    for q in flips:
+        fl |= 1 << (q - span_lo)
+    for s in range(dim):
+        ok = all(((s >> (c - span_lo)) & 1) == st for c, st in zip(cbits, states))
+        mr[s ^ fl if ok else s, s] = 1
+    return mr
+
+
 @partial(jax.jit, static_argnames=("n", "targets", "controls", "control_states"),
          donate_argnums=(0,))
 def apply_x_class(amps, *, n: int, targets: tuple[int, ...],
                   controls: tuple[int, ...] = (), control_states: tuple[int, ...] = ()):
-    """Multi-controlled multi-qubit NOT: pure axis reversal, no matmul.
+    """Multi-controlled multi-qubit NOT: an amplitude permutation.
 
     The reference's pauliX/controlledNot/multiControlledMultiQubitNot kernels
     (``QuEST_cpu.c``, dispatch ``QuEST_cpu_distributed.c:1109-1152``) are
-    amplitude permutations; here each X flips one 2-sized axis, which XLA
-    compiles to a strided copy (or a collective permute when the axis is
-    sharded).
+    strided-copy loops. Here, compact supports become a control-folded
+    permutation matrix through the layout-clean window GEMM; wide supports
+    take the grouped flip (fine at small n, sharded axes become collective
+    permutes).
     """
     states = control_states if control_states else (1,) * len(controls)
+    support = tuple(targets) + tuple(controls)
+    lo, hi = min(support), max(support)
+    if hi - lo + 1 <= _PERM_WINDOW_MAX:
+        import numpy as np
+        mr = _window_perm_matrix(lo, hi, targets, controls, states,
+                                 np.dtype(amps.dtype))
+        m = jnp.stack([jnp.asarray(mr), jnp.zeros_like(jnp.asarray(mr))])
+        return _apply_matrix_window(amps, m[0], m[1], n, lo, hi)
     shape, perm, inv = _plan(n, targets, controls)
     tensor = amps.reshape(shape).transpose(perm)
     nc = len(controls)
@@ -190,6 +223,25 @@ def apply_swap(amps, *, n: int, qb1: int, qb2: int, controls: tuple[int, ...] = 
     ``QuEST_cpu_distributed.c:1424-1459``). On a sharded axis this *is* the
     all-to-all the reference hand-codes -- and it is also the primitive the
     distributed scheduler uses to localise far targets."""
+    support = (qb1, qb2) + tuple(controls)
+    lo, hi = min(support), max(support)
+    if hi - lo + 1 <= _PERM_WINDOW_MAX:
+        import numpy as np
+        k = hi - lo + 1
+        dim = 1 << k
+        mr = np.zeros((dim, dim), dtype=np.dtype(amps.dtype))
+        b1, b2 = qb1 - lo, qb2 - lo
+        for s in range(dim):
+            ok = all(((s >> (c - lo)) & 1) == 1 for c in controls)
+            if ok:
+                v1, v2 = (s >> b1) & 1, (s >> b2) & 1
+                s2 = s & ~(1 << b1) & ~(1 << b2) | (v2 << b1) | (v1 << b2)
+            else:
+                s2 = s
+            mr[s2, s] = 1
+        m = jnp.asarray(mr)
+        return _apply_matrix_window(amps, m, jnp.zeros_like(m), n, lo, hi)
+
     shape, perm, inv = _plan(n, (qb1, qb2), controls)
     tensor = amps.reshape(shape).transpose(perm)
     nc = len(controls)

@@ -17,6 +17,10 @@ broadcasted-factor path, like the reference's dedicated dephase kernels
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from . import apply, cplx, diagonal
@@ -37,12 +41,62 @@ def kraus_superoperator(kraus_ops) -> np.ndarray:
     return s
 
 
+#: up to this many flattened qubits the one-pass superoperator apply is used;
+#: beyond it, the scattered (q, q+n) target pair would take the grouped-
+#: transpose path whose tile padding explodes at scale (see ops.apply), so
+#: the channel is applied as a sum of per-Kraus-term window passes instead.
+_SUPEROP_MAX_QUBITS = 22
+
+
+def choi_kraus(superop) -> list[tuple[float, np.ndarray]]:
+    """Decompose a superoperator (ordered as :func:`kraus_superoperator`,
+    sum_k conj(K) (x) K) into weighted Kraus terms [(sign, K_i), ...] via
+    the eigendecomposition of its Choi matrix. Signs carry non-CP maps
+    (mixNonTP* family); CP maps yield all +1."""
+    d2 = superop.shape[0]
+    d = int(np.sqrt(d2))
+    s = np.asarray(superop, dtype=np.complex128).reshape(d, d, d, d)
+    # S[(c',r'),(c,r)] -> M[(r',r),(c',c)] = sum_k vec(K_k) vec(K_k)^dagger
+    m = s.transpose(1, 3, 0, 2).reshape(d2, d2)
+    vals, vecs = np.linalg.eigh((m + m.conj().T) / 2)
+    out = []
+    for lam, v in zip(vals, vecs.T):
+        if abs(lam) < 1e-12:
+            continue
+        out.append((float(np.sign(lam)), np.sqrt(abs(lam)) * v.reshape(d, d)))
+    return out
+
+
 def apply_channel(amps, superop, *, n: int, targets: tuple[int, ...]):
     """Apply a (numpy complex) superoperator to density targets: qubits
-    (T..., T+n...) of the flattened 2n-qubit state."""
-    ext_targets = tuple(targets) + tuple(q + n for q in targets)
-    so = cplx.from_complex(superop, amps.dtype)
-    return apply.apply_matrix(amps, so, n=2 * n, targets=ext_targets)
+    (T..., T+n...) of the flattened 2n-qubit state.
+
+    Large registers use the Kraus-sum formulation: rho' = sum_i s_i K_i rho
+    K_i^dagger, each term two layout-clean single-group passes (row bits,
+    then conjugated column bits) -- the TPU equivalent of the reference's
+    pair-exchange channel protocol (QuEST_cpu_distributed.c:724-868)."""
+    if 2 * n <= _SUPEROP_MAX_QUBITS:
+        ext_targets = tuple(targets) + tuple(q + n for q in targets)
+        so = cplx.from_complex(superop, amps.dtype)
+        return apply.apply_matrix(amps, so, n=2 * n, targets=ext_targets)
+
+    terms = choi_kraus(superop)
+    signs = tuple(s for s, _ in terms)
+    ks = np.stack([np.stack([k.real, k.imag]) for _, k in terms])
+    return _apply_kraus_sum(amps, jnp.asarray(ks, dtype=amps.dtype),
+                            n=n, targets=tuple(targets), signs=signs)
+
+
+@partial(jax.jit, static_argnames=("n", "targets", "signs"), donate_argnums=(0,))
+def _apply_kraus_sum(amps, ks, *, n: int, targets: tuple[int, ...],
+                     signs: tuple[float, ...]):
+    shifted = tuple(q + n for q in targets)
+    out = jnp.zeros_like(amps)
+    for i, sign in enumerate(signs):
+        t = apply.apply_matrix(amps + 0, ks[i], n=2 * n, targets=targets)
+        t = apply.apply_matrix(t, ks[i], n=2 * n, targets=shifted, conj=True)
+        out = out + (sign * t if sign != 1.0 else t)
+    return out
 
 
 def dephase_factors_1q(prob: float) -> np.ndarray:

@@ -99,6 +99,19 @@ def _ctrl_scalar_and_mask(controls, states, tile_bits, shape):
     return scalar, mask
 
 
+def _keep_factor(controls, states, tile_bits, shape, dtype):
+    """{0,1} dtype factor that is 1 exactly where the control pattern is
+    satisfied (combining grid-bit scalars and in-tile masks), or None."""
+    scalar, mask = _ctrl_scalar_and_mask(controls, states, tile_bits, shape)
+    if scalar is not None and mask is not None:
+        return (scalar * mask).astype(dtype)
+    if scalar is not None:
+        return (scalar * jnp.ones(shape, jnp.int32)).astype(dtype)
+    if mask is not None:
+        return mask.astype(dtype)
+    return None
+
+
 def _make_kernel(ops, s_bits, tile_bits, dtype):
     one = np.array(1, dtype)
 
@@ -120,19 +133,16 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                 csi = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
                 cpr = jnp.where(bit == 0, dtype.type(m01.real), dtype.type(m10.real))
                 cpi = jnp.where(bit == 0, dtype.type(m01.imag), dtype.type(m10.imag))
-                nr = csr * xr - csi * xi + cpr * pr - cpi * pi
-                ni = csr * xi + csi * xr + cpr * pi + cpi * pr
-                scalar, mask = _ctrl_scalar_and_mask(
-                    controls, states, tile_bits, shape)
-                if mask is not None:
-                    keep = mask.astype(dtype)
-                    nr = keep * nr + (one - keep) * xr
-                    ni = keep * ni + (one - keep) * xi
-                if scalar is not None:
-                    keep = scalar.astype(dtype)
-                    nr = keep * nr + (one - keep) * xr
-                    ni = keep * ni + (one - keep) * xi
-                xr, xi = nr, ni
+                # fold controls into the coefficients (identity where the
+                # control pattern misses) -- cheaper than output blending
+                keep = _keep_factor(controls, states, tile_bits, shape, dtype)
+                if keep is not None:
+                    csr = one + keep * (csr - one)
+                    csi = keep * csi
+                    cpr = keep * cpr
+                    cpi = keep * cpi
+                xr, xi = (csr * xr - csi * xi + cpr * pr - cpi * pi,
+                          csr * xi + csi * xr + cpr * pi + cpi * pr)
 
             elif op[0] == "parity":
                 _, qubits, controls, theta = op
@@ -150,21 +160,13 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                     sign = sign * (1 - 2 * par).astype(dtype)
                 c = dtype.type(math.cos(theta / 2))
                 s = dtype.type(math.sin(theta / 2))
-                fr = c
+                fr = c * jnp.ones_like(sign)
                 fi = -s * sign
-                nr = xr * fr - xi * fi
-                ni = xr * fi + xi * fr
-                scalar, mask = _ctrl_scalar_and_mask(
-                    controls, (), tile_bits, shape)
-                if mask is not None:
-                    keep = mask.astype(dtype)
-                    nr = keep * nr + (one - keep) * xr
-                    ni = keep * ni + (one - keep) * xi
-                if scalar is not None:
-                    keep = scalar.astype(dtype)
-                    nr = keep * nr + (one - keep) * xr
-                    ni = keep * ni + (one - keep) * xi
-                xr, xi = nr, ni
+                keep = _keep_factor(controls, (), tile_bits, shape, dtype)
+                if keep is not None:
+                    fr = one + keep * (fr - one)
+                    fi = keep * fi
+                xr, xi = (xr * fr - xi * fi, xr * fi + xi * fr)
 
             else:  # pragma: no cover
                 raise ValueError(f"unknown pallas op {op[0]!r}")

@@ -7,7 +7,7 @@ import pytest
 import quest_tpu as qt
 
 from . import oracle
-from .helpers import assert_density_equal, set_density
+from .helpers import TOL, assert_density_equal, set_density
 
 N = 4  # density tests use 4 qubits to stay fast (16x16 matrices)
 ENV = qt.createQuESTEnv()
@@ -172,3 +172,29 @@ def test_validation_non_cptp(rho_pair):
     q, _ = rho_pair
     with pytest.raises(qt.QuESTError, match="CPTP"):
         qt.mixKrausMap(q, 0, [np.eye(2) * 0.5])
+
+
+def test_kraus_sum_path_matches_superop(monkeypatch):
+    """Large registers route channels through the Kraus-term-sum path
+    (ops/density.py); force it here and compare against the one-pass
+    superoperator application."""
+    from quest_tpu.ops import density as DN
+
+    rng = np.random.RandomState(3)
+    d = qt.createDensityQureg(4, ENV)
+    qt.initPlusState(d)
+    qt.rotateY(d, 0, 0.7)
+    qt.controlledNot(d, 0, 2)
+    ref_amps = d.amps + 0
+
+    dim = 2
+    ops = [rng.randn(dim, dim) + 1j * rng.randn(dim, dim) for _ in range(3)]
+    norm = sum(k.conj().T @ k for k in ops)
+    w = np.linalg.cholesky(np.linalg.inv(norm))
+    ops = [k @ w for k in ops]
+    S = DN.kraus_superoperator(ops)
+
+    a = DN.apply_channel(d.amps + 0, S, n=4, targets=(1,))
+    monkeypatch.setattr(DN, "_SUPEROP_MAX_QUBITS", 0)
+    b = DN.apply_channel(ref_amps, S, n=4, targets=(1,))
+    np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=TOL)
