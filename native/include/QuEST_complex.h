@@ -1,0 +1,47 @@
+/** Native-complex interop for the quest-tpu C API.
+ *
+ * Gives user code a natural complex scalar type (`qcomp`) alongside the
+ * API's struct `Complex`, with `toComplex` / `fromComplex` converters --
+ * the same surface as the reference's QuEST/include/QuEST_complex.h (144
+ * lines), re-derived for this shim (C99 `double complex` in C mode, a
+ * std::complex alias in C++ mode).
+ *
+ * Usage:
+ *   qcomp amp = 1.0 + 2.0*I;             // C
+ *   qcomp amp = qcomp(1.0, 2.0);          // C++
+ *   compactUnitary(q, 0, toComplex(a), toComplex(b));
+ *   qcomp out = fromComplex(calcInnerProduct(bra, ket));
+ */
+#ifndef QUEST_TPU_COMPLEX_H
+#define QUEST_TPU_COMPLEX_H
+
+#include "QuEST_precision.h"
+
+#ifdef __cplusplus
+
+#include <cmath>
+#include <complex>
+
+typedef std::complex<qreal> qcomp;
+
+#define toComplex(scalar) \
+    ((Complex){.real = (scalar).real(), .imag = (scalar).imag()})
+#define fromComplex(comp) qcomp((comp).real, (comp).imag)
+
+#else /* C99 */
+
+#include <complex.h>
+
+#if QuEST_PREC == 1
+typedef float complex qcomp;
+#else
+typedef double complex qcomp;
+#endif
+
+#define toComplex(scalar) \
+    ((Complex){.real = creal(scalar), .imag = cimag(scalar)})
+#define fromComplex(comp) ((comp).real + I * (comp).imag)
+
+#endif /* __cplusplus */
+
+#endif /* QUEST_TPU_COMPLEX_H */

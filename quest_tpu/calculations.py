@@ -4,6 +4,8 @@
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
 from . import validation as V
@@ -143,9 +145,62 @@ def calcExpecPauliProd(qureg: Qureg, targets, paulis, workspace: Qureg) -> float
     return float(R.inner_product(qureg.amps, workspace.amps)[0])
 
 
+def _pauli_prod_amps(amps, term, nsv, dt):
+    """P|amps> for one static code tuple (inlined under jit)."""
+    from . import matrices
+    from .ops import apply as K, cplx, diagonal as D
+    for t, c in enumerate(term):
+        if c == 0:
+            continue
+        if c == 1:
+            amps = K.apply_x_class(amps, n=nsv, targets=(t,))
+        elif c == 2:
+            amps = K.apply_matrix(amps, cplx.from_complex(matrices.PAULI_Y_M, dt),
+                                  n=nsv, targets=(t,))
+        else:
+            amps = D.apply_diagonal(amps, cplx.from_complex(np.array([1.0, -1.0]), dt),
+                                    n=nsv, targets=(t,))
+    return amps
+
+
+def _expec_pauli_sum_fused(amps, coeffs, *, codes, n, density):
+    """sum_t c_t <P_t>, the whole sum as ONE XLA program.
+
+    The reference pays a full state clone, O(n) kernel launches, and an
+    Allreduce per term (QuEST_common.c:505-532); here the term loop unrolls
+    at trace time so XLA schedules every term's Pauli pipeline and reduction
+    inside a single dispatch (SURVEY.md section 3.5's noted fusion win)."""
+    return _expec_pauli_sum_run(amps, coeffs, codes=codes, n=n,
+                                density=density)
+
+
+def _make_expec_pauli_sum_run():
+    import jax
+
+    @partial(jax.jit, static_argnames=("codes", "n", "density"))
+    def run(amps, coeffs, *, codes, n, density):
+        nsv = (2 if density else 1) * n
+        total = 0.0
+        for t, term in enumerate(codes):
+            work = _pauli_prod_amps(amps, term, nsv, amps.dtype)
+            if density:
+                val = R.total_prob_density(work, n=n)
+            else:
+                val = R.inner_product(amps, work)[0]
+            total = total + coeffs[t] * val
+        return total
+
+    return run
+
+
+_expec_pauli_sum_run = _make_expec_pauli_sum_run()
+
+
 def calcExpecPauliSum(qureg: Qureg, all_pauli_codes, term_coeffs, workspace: Qureg) -> float:
-    """sum_t c_t <P_t> (QuEST.h:4832); clone-per-term like the reference
-    (QuEST_common.c:520-532)."""
+    """sum_t c_t <P_t> (QuEST.h:4832). Reference semantics (the workspace is
+    scratch with unspecified final state), but fused: one compiled program
+    for the whole sum instead of the reference's clone + launches + reduce
+    per term (QuEST_common.c:520-532)."""
     func = "calcExpecPauliSum"
     codes = np.asarray(all_pauli_codes, dtype=np.int32).reshape(len(term_coeffs), -1)
     V._assert(codes.size == len(term_coeffs) * qureg.num_qubits_represented,
@@ -154,18 +209,13 @@ def calcExpecPauliSum(qureg: Qureg, all_pauli_codes, term_coeffs, workspace: Qur
     V.validate_pauli_codes(codes.ravel(), func)
     V.validate_matching_qureg_types(qureg, workspace, func)
     V.validate_matching_qureg_dims(qureg, workspace, func)
-    n = qureg.num_qubits_represented
-    total = 0.0
-    targets = list(range(n))
-    for t in range(codes.shape[0]):
-        workspace.put(qureg.amps + 0)
-        _apply_pauli_prod(workspace, targets, codes[t])
-        if qureg.is_density_matrix:
-            term = float(R.total_prob_density(workspace.amps, n=n))
-        else:
-            term = float(R.inner_product(qureg.amps, workspace.amps)[0])
-        total += float(term_coeffs[t]) * term
-    return total
+    import jax.numpy as jnp
+    coeffs = jnp.asarray(np.asarray(term_coeffs, dtype=np.float64), dtype=qureg.dtype)
+    total = _expec_pauli_sum_fused(
+        qureg.amps, coeffs,
+        codes=tuple(tuple(int(c) for c in row) for row in codes),
+        n=qureg.num_qubits_represented, density=qureg.is_density_matrix)
+    return float(total)
 
 
 def calcExpecPauliHamil(qureg: Qureg, hamil: PauliHamil, workspace: Qureg) -> float:

@@ -1,0 +1,72 @@
+"""Multi-host (multi-slice / DCN) environment setup.
+
+The reference reaches multi-node scale through mpirun + MPI_Init
+(QuEST_cpu_distributed.c:131-164); the JAX equivalent is
+``jax.distributed.initialize`` on every host followed by building one
+global mesh over ``jax.devices()``. This module packages that, so a pod
+user writes:
+
+    import quest_tpu as qt
+    from quest_tpu.parallel import multihost
+
+    multihost.init()                       # no-op on single host
+    env = qt.createQuESTEnv()              # mesh over ALL hosts' devices
+    qureg = qt.createQureg(36, env)        # sharded across the pod
+
+Design note (SURVEY.md section 2.5): amplitude sharding is this
+framework's one parallel axis, so the mesh is 1-D over every global
+device; XLA routes the resulting collectives over ICI within a slice and
+DCN across slices. Host-local process coordination (the reference's rank
+broadcast of seeds, QuEST_cpu_distributed.c:1400-1418) is unnecessary:
+JAX's single-controller-per-host SPMD model ships identical host code,
+and seeding is deterministic given the same user-provided seeds.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init", "is_multihost", "process_info"]
+
+
+def init(coordinator_address: str | None = None,
+         num_processes: int | None = None,
+         process_id: int | None = None) -> None:
+    """Initialise cross-host communication (idempotent; no-op when the
+    JAX runtime already knows its topology, e.g. TPU pod metadata).
+
+    On Cloud TPU pods all three arguments auto-detect; elsewhere pass them
+    explicitly or via JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID, exactly like mpirun's rank/size but resolved by the
+    JAX distributed runtime instead of an MPI launcher."""
+    if jax.process_count() > 1:
+        return  # already initialised
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None and num_processes is None:
+        # single host, or TPU-pod autodetection at first backend use
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass  # single-process environments: nothing to do
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def process_info() -> dict:
+    """Rank-style identity, the analogue of the reference env's
+    (rank, numRanks) pair (QuEST.h:405-415)."""
+    return {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

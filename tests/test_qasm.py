@@ -1,0 +1,77 @@
+"""QASM logger tests (quest_tpu/qasm.py; reference QuEST_qasm.c + the
+startRecordingQASM..writeRecordedQASMToFile API, QuEST.h:3906-3965)."""
+
+import numpy as np
+
+import quest_tpu as qt
+
+ENV = qt.createQuESTEnv()
+
+
+def _recorded(qureg):
+    return qureg.qasm_log.printed()
+
+
+def test_header_and_basic_gates():
+    q = qt.createQureg(3, ENV)
+    qt.startRecordingQASM(q)
+    qt.hadamard(q, 0)
+    qt.tGate(q, 1)
+    qt.rotateZ(q, 2, 0.5)
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    lines = text.strip().splitlines()
+    assert lines[0] == "OPENQASM 2.0;"
+    assert lines[1] == "qreg q[3];"
+    assert lines[2] == "creg c[3];"
+    assert "h q[0];" in text
+    assert "t q[1];" in text
+    assert "Rz(0.5) q[2];" in text
+
+
+def test_controlled_and_multi_controlled():
+    q = qt.createQureg(4, ENV)
+    qt.startRecordingQASM(q)
+    qt.controlledNot(q, 0, 1)
+    qt.multiControlledPhaseFlip(q, [0, 1, 2])
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    assert "cx q[0],q[1];" in text or "csigmaX q[0],q[1];" in text.replace(" ", " ")
+    # multi-controlled ops fall back to comments, as the reference
+    assert "//" in text
+
+
+def test_not_recording_by_default_and_stop():
+    q = qt.createQureg(2, ENV)
+    qt.hadamard(q, 0)
+    assert "h q[0];" not in _recorded(q)
+    qt.startRecordingQASM(q)
+    qt.hadamard(q, 0)
+    qt.stopRecordingQASM(q)
+    qt.hadamard(q, 1)
+    text = _recorded(q)
+    assert "h q[0];" in text and "h q[1];" not in text
+
+
+def test_clear_and_write_to_file(tmp_path):
+    q = qt.createQureg(2, ENV)
+    qt.startRecordingQASM(q)
+    qt.hadamard(q, 0)
+    qt.clearRecordedQASM(q)
+    qt.pauliX(q, 1)
+    qt.stopRecordingQASM(q)
+    path = tmp_path / "circ.qasm"
+    qt.writeRecordedQASMToFile(q, str(path))
+    text = path.read_text()
+    assert "h q[0];" not in text
+    assert "x q[1];" in text
+    assert text.startswith("OPENQASM 2.0;")
+
+
+def test_measurement_recorded():
+    q = qt.createQureg(2, ENV)
+    qt.initPlusState(q)
+    qt.startRecordingQASM(q)
+    qt.measure(q, 0)
+    qt.stopRecordingQASM(q)
+    assert "measure q[0] -> c[0];" in _recorded(q)
