@@ -422,12 +422,37 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
 def _apply_pallas_run(qureg, ops: tuple, tile_bits: int) -> None:
     """Tape-entry wrapper for a PallasRun (state-vector registers only; the
     density shadow would target qubits >= tile_bits, which the kernel cannot
-    pair -- density tapes never produce PallasRuns, see Circuit.fused)."""
+    pair -- density tapes never produce PallasRuns, see Circuit.fused).
+
+    Sharded registers fall back to the ordinary engine gate-by-gate: a
+    pallas_call is not partitioned by GSPMD, so running the kernel on a
+    multi-device array would gather the whole state onto one device.
+    """
     from .ops.pallas_gates import fused_local_run
 
     assert not qureg.is_density_matrix
+    sharding = getattr(qureg.amps, "sharding", None)
+    if sharding is not None and len(sharding.device_set) > 1:
+        _apply_ops_via_engine(qureg, ops)
+        return
     qureg.put(fused_local_run(qureg.amps, n=qureg.num_qubits_in_state_vec,
                               ops=ops))
+
+
+def _apply_ops_via_engine(qureg, ops: tuple) -> None:
+    """Replay pallas-format ops through the standard kernels (sharding-aware
+    via GSPMD or the explicit scheduler)."""
+    from . import gates as G
+
+    for op in ops:
+        if op[0] == "matrix":
+            _, q, controls, states, m = op
+            G._apply_gate_matrix(qureg, np.asarray(m.arr), (q,), controls, states)
+        elif op[0] == "parity":
+            _, qubits, controls, theta = op
+            G._apply_gate_parity_phase(qureg, theta, qubits, controls)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown pallas op {op[0]!r}")
 
 
 def as_tape(p: FusePlan) -> list:

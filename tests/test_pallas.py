@@ -106,3 +106,30 @@ def test_small_register_falls_back_to_ordinary_fusion():
     mk = lambda: ops_init.init_debug(1 << 6, real_dtype())
     np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
                                np.asarray(circ.as_fn()(mk())), atol=TOL)
+
+
+def test_sharded_register_falls_back_to_engine():
+    """PallasRuns on a multi-device register must route through the
+    sharding-aware engine (pallas_call is not GSPMD-partitioned)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    env = qt.createQuESTEnv(jax.devices()[:8])
+    qureg = qt.createQureg(10, env)
+    qt.initPlusState(qureg)
+    assert len(qureg.amps.sharding.device_set) > 1
+
+    from __graft_entry__ import _random_layers
+    circ = Circuit(10)
+    _random_layers(circ, 10, depth=2)
+    fz = circ.fused(max_qubits=5, pallas=True)
+    assert any(f.__name__ == "_apply_pallas_run" for f, _, _ in fz._tape)
+    fz.run(qureg)
+    assert abs(qt.calcTotalProb(qureg) - 1.0) < TOL
+
+    ref = qt.createQureg(10, qt.createQuESTEnv(jax.devices()[:1]))
+    qt.initPlusState(ref)
+    circ.run(ref)
+    np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
+                               atol=TOL)
