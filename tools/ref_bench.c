@@ -1,0 +1,78 @@
+/* Reference-QuEST timing anchor for BASELINE.md / bench.py.
+ *
+ * Builds the same pseudo-random Clifford+T layer circuit as
+ * __graft_entry__._random_layers (H/T/Rz/Rx layers + CNOT ladders +
+ * long-range controlled-phase-flip, seed-matched shape, NOT amplitudes:
+ * the RNG differs, but the gate mix and memory traffic are identical)
+ * and reports gates/sec through the reference's own C API.
+ *
+ * Build (out of tree; QUEST_SRC points at the reference checkout):
+ *   cmake -S $QUEST_SRC -B /tmp/quest_ref -DUSER_SOURCE=$PWD/tools/ref_bench.c \
+ *         -DOUTPUT_EXE=ref_bench -DMULTITHREADED=1 -DCMAKE_BUILD_TYPE=Release
+ *   cmake --build /tmp/quest_ref -j
+ *   /tmp/quest_ref/ref_bench <qubits> <depth> <reps>
+ */
+#include "QuEST.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+static double now_sec(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec + 1e-9 * ts.tv_nsec;
+}
+
+static unsigned int rng_state = 2026;
+static unsigned int next_rand(void) {
+    /* small LCG so every build produces the same gate sequence */
+    rng_state = rng_state * 1664525u + 1013904223u;
+    return rng_state >> 16;
+}
+
+static long apply_layers(Qureg q, int n, int depth) {
+    long gates = 0;
+    for (int layer = 0; layer < depth; layer++) {
+        for (int t = 0; t < n; t++) {
+            switch (next_rand() % 4) {
+                case 0: hadamard(q, t); break;
+                case 1: tGate(q, t); break;
+                case 2: rotateZ(q, t, (next_rand() % 628) / 100.0); break;
+                default: rotateX(q, t, (next_rand() % 628) / 100.0); break;
+            }
+            gates++;
+        }
+        for (int t = layer % 2; t < n - 1; t += 2) {
+            controlledNot(q, t, t + 1);
+            gates++;
+        }
+        controlledPhaseFlip(q, 0, n - 1);
+        gates++;
+    }
+    return gates;
+}
+
+int main(int argc, char **argv) {
+    int n = argc > 1 ? atoi(argv[1]) : 20;
+    int depth = argc > 2 ? atoi(argv[2]) : 8;
+    int reps = argc > 3 ? atoi(argv[3]) : 3;
+
+    QuESTEnv env = createQuESTEnv();
+    Qureg q = createQureg(n, env);
+    initClassicalState(q, 0);
+
+    long gates = apply_layers(q, n, depth); /* warm caches */
+    double t0 = now_sec();
+    long total = 0;
+    for (int r = 0; r < reps; r++)
+        total += apply_layers(q, n, depth);
+    double dt = now_sec() - t0;
+
+    printf("{\"qubits\": %d, \"gates\": %ld, \"reps\": %d, "
+           "\"gates_per_sec\": %.2f}\n", n, gates, reps, total / dt);
+
+    destroyQureg(q, env);
+    destroyQuESTEnv(env);
+    return 0;
+}
