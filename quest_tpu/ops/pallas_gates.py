@@ -28,6 +28,15 @@ Ops format (all matrix data static at trace time, baked into the kernel):
 
     ("matrix", q, controls, states, M)   M: 2x2 complex ndarray, q local
     ("parity", qubits, controls, theta)  exp(-i theta/2 Z...Z), any qubits
+    ("lane_u", W)                        W: 256x256 real block matrix from
+                                         _fold_lane_ops -- a whole run of
+                                         lane-qubit gates as ONE MXU dot
+
+Before the kernel is built, consecutive ops confined to the 7 lane qubits
+are folded host-side into a single 128x128 unitary and applied by one
+in-kernel matmul on the lane axis (MXU), instead of per-gate butterfly
+rolls (VPU) -- the same dense-fusion economics as quest_tpu/fusion.py, one
+level down.
 """
 
 from __future__ import annotations
@@ -99,6 +108,57 @@ def _ctrl_scalar_and_mask(controls, states, tile_bits, shape):
     return scalar, mask
 
 
+def _lane_foldable(op) -> bool:
+    """True if the op acts entirely within the 7 lane qubits."""
+    if op[0] == "matrix":
+        return op[1] < LANE_BITS and all(c < LANE_BITS for c in op[2])
+    if op[0] == "parity":
+        return (all(q < LANE_BITS for q in op[1])
+                and all(c < LANE_BITS for c in op[2]))
+    return False
+
+
+def _fold_lane_ops(ops) -> tuple:
+    """Contract each run of >=2 consecutive lane-local ops into one
+    ("lane_u", W) entry, where W is the 256x256 real block form
+    [[Ur^T, Ui^T], [-Ui^T, Ur^T]] of the accumulated 128x128 unitary U:
+    with y = (xr | xi) per sublane row, y @ W applies U on the lane axis."""
+    from ..fusion import GateEvent, event_matrix
+
+    lane_qubits = tuple(range(LANE_BITS))
+    out = []
+    run = []
+
+    def flush():
+        if len(run) < 2:
+            out.extend(run)
+            run.clear()
+            return
+        U = np.eye(1 << LANE_BITS, dtype=complex)
+        for op in run:
+            if op[0] == "matrix":
+                ev = GateEvent("matrix", (op[1],), tuple(op[2]), tuple(op[3]),
+                               matrix=np.asarray(op[4].arr if hasattr(op[4], "arr")
+                                                 else op[4]))
+            else:
+                ev = GateEvent("parity", tuple(op[1]), tuple(op[2]),
+                               theta=float(op[3]))
+            U = event_matrix(ev, lane_qubits) @ U
+        ur, ui = U.real, U.imag
+        W = np.block([[ur.T, ui.T], [-ui.T, ur.T]])
+        out.append(("lane_u", HashableMatrix(W)))
+        run.clear()
+
+    for op in ops:
+        if _lane_foldable(op):
+            run.append(op)
+        else:
+            flush()
+            out.append(op)
+    flush()
+    return tuple(out)
+
+
 def _keep_factor(controls, states, tile_bits, shape, dtype):
     """{0,1} dtype factor that is 1 exactly where the control pattern is
     satisfied (combining grid-bit scalars and in-tile masks), or None."""
@@ -113,21 +173,58 @@ def _keep_factor(controls, states, tile_bits, shape, dtype):
 
 
 def _make_kernel(ops, s_bits, tile_bits, dtype):
+    """Kernel over (x_ref, *w_refs, o_ref); ops of kind 'lane_u' carry an
+    index into w_refs (their 256x256 block matrices arrive as operands --
+    Pallas kernels may not capture array constants)."""
     one = np.array(1, dtype)
 
-    def kernel(x_ref, o_ref):
+    def kernel(x_ref, *refs):
+        w_refs = refs[:-1]
+        o_ref = refs[-1]
         xr = x_ref[0]
         xi = x_ref[1]
         shape = xr.shape
 
         for op in ops:
-            if op[0] == "matrix":
+            if op[0] == "lane_u":
+                W = w_refs[op[1]][:]                          # (256, 256)
+                y = jnp.concatenate([xr, xi], axis=1)         # (S, 256)
+                y = jnp.dot(y, W, preferred_element_type=y.dtype,
+                            precision=jax.lax.Precision.HIGHEST)
+                xr = y[:, :_LANES]
+                xi = y[:, _LANES:]
+
+            elif op[0] == "matrix":
                 _, q, controls, states, M = op
                 m00, m01, m10, m11 = (complex(M[0, 0]), complex(M[0, 1]),
                                       complex(M[1, 0]), complex(M[1, 1]))
                 bit = _bit_mask(q, shape)
+
+                if m01 == 0 and m10 == 0:
+                    # diagonal 2x2: no partner exchange at all
+                    dr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
+                    di = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
+                    keep = _keep_factor(controls, states, tile_bits, shape, dtype)
+                    if keep is not None:
+                        dr = one + keep * (dr - one)
+                        di = keep * di
+                    xr, xi = (dr * xr - di * xi, dr * xi + di * xr)
+                    continue
+
                 pr = _partner(xr, q)
                 pi = _partner(xi, q)
+
+                if (m00.imag == 0 and m01.imag == 0 and
+                        m10.imag == 0 and m11.imag == 0):
+                    # real matrix (H, X, Ry...): half the arithmetic
+                    csr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
+                    cpr = jnp.where(bit == 0, dtype.type(m01.real), dtype.type(m10.real))
+                    keep = _keep_factor(controls, states, tile_bits, shape, dtype)
+                    if keep is not None:
+                        csr = one + keep * (csr - one)
+                        cpr = keep * cpr
+                    xr, xi = (csr * xr + cpr * pr, csr * xi + cpr * pi)
+                    continue
                 # coefficient planes: self = m00/m11, pair = m01/m10 by bit q
                 csr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
                 csi = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
@@ -194,8 +291,8 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
         raise ValueError(
             f"matrix target >= local_qubits({n}, {sublanes}) = "
             f"{local_qubits(n, sublanes)}; route wide targets via ops.apply")
-    return _fused_local_run(amps, n=n, ops=ops, sublanes=sublanes,
-                            interpret=bool(interpret))
+    return _fused_local_run(amps, n=n, ops=_fold_lane_ops(ops),
+                            sublanes=sublanes, interpret=bool(interpret))
 
 
 @partial(jax.jit, static_argnames=("n", "ops", "sublanes", "interpret"),
@@ -209,21 +306,35 @@ def _fused_local_run(amps, *, n: int, ops: tuple, sublanes: int,
     tile_bits = LANE_BITS + s_bits
     grid = rows // s
 
-    ops_r = tuple((o[0], o[1], o[2], o[3], np.asarray(o[4].arr if hasattr(o[4], "arr") else o[4]))
-                  if o[0] == "matrix" else o for o in ops)
-    kernel = _make_kernel(ops_r, s_bits, tile_bits, np.dtype(amps.dtype))
+    # lane_u block matrices become pallas operands (replicated per program);
+    # their op entries carry the operand index instead of the matrix
+    ws = []
+    ops_r = []
+    for o in ops:
+        if o[0] == "lane_u":
+            ops_r.append(("lane_u", len(ws)))
+            ws.append(jnp.asarray(np.asarray(o[1].arr.real, dtype=amps.dtype)))
+        elif o[0] == "matrix":
+            ops_r.append((o[0], o[1], o[2], o[3],
+                          np.asarray(o[4].arr if hasattr(o[4], "arr") else o[4])))
+        else:
+            ops_r.append(o)
+    kernel = _make_kernel(tuple(ops_r), s_bits, tile_bits, np.dtype(amps.dtype))
 
+    wdim = 2 * _LANES
     x = amps.reshape(2, rows, _LANES)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         grid=(grid,),
         in_specs=[pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
-                               memory_space=pltpu.VMEM)],
+                               memory_space=pltpu.VMEM)] +
+                 [pl.BlockSpec((wdim, wdim), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)] * len(ws),
         out_specs=pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(x)
+    )(x, *ws)
     return out.reshape(2, -1)
 
 
