@@ -19,6 +19,8 @@
 #include <cstring>
 #include <string>
 
+#include <dlfcn.h>
+
 extern "C" {
 #include "QuEST.h"
 }
@@ -43,12 +45,30 @@ static void ensureInit(void) {
         PyConfig_Clear(&config);
         if (PyStatus_Exception(status)) fatalPy("Py_InitializeFromConfig");
     }
-    /* make quest_tpu importable: honour QUEST_TPU_PYTHONPATH, else cwd */
-    PyRun_SimpleString(
+    /* make quest_tpu importable: honour QUEST_TPU_PYTHONPATH, else cwd,
+     * else walk up from this shared library's own location (native/build/
+     * libquest_tpu_capi.so -> repo root two levels up) */
+    Dl_info dli;
+    char libdir[4096] = "";
+    if (dladdr((void *)&ensureInit, &dli) && dli.dli_fname) {
+        snprintf(libdir, sizeof libdir, "%s", dli.dli_fname);
+        char *slash = strrchr(libdir, '/');
+        if (slash) *slash = '\0';
+    }
+    char bootstrap[8192];
+    snprintf(bootstrap, sizeof bootstrap,
         "import sys, os\n"
         "for _p in (os.environ.get('QUEST_TPU_PYTHONPATH') or '').split(':')[::-1]:\n"
         "    if _p and _p not in sys.path: sys.path.insert(0, _p)\n"
-        "if os.getcwd() not in sys.path: sys.path.insert(0, os.getcwd())\n");
+        "if os.getcwd() not in sys.path: sys.path.insert(0, os.getcwd())\n"
+        "_d = %s%s%s\n"
+        "while _d and _d != os.path.dirname(_d):\n"
+        "    if os.path.isdir(os.path.join(_d, 'quest_tpu')):\n"
+        "        if _d not in sys.path: sys.path.insert(0, _d)\n"
+        "        break\n"
+        "    _d = os.path.dirname(_d)\n",
+        libdir[0] ? "r'" : "''", libdir[0] ? libdir : "", libdir[0] ? "'" : "");
+    PyRun_SimpleString(bootstrap);
     gBridge = PyImport_ImportModule("quest_tpu.capi_bridge");
     if (!gBridge) fatalPy("import quest_tpu.capi_bridge");
 }
