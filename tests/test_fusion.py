@@ -73,7 +73,7 @@ def test_fused_statevector_agrees(seed, max_qubits):
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
     ref = np.asarray(circ.as_fn()(mk()))
     got = np.asarray(fz.as_fn()(mk()))
-    np.testing.assert_allclose(got, ref, atol=TOL)
+    np.testing.assert_allclose(got, ref, atol=TOL, rtol=TOL)
 
 
 def test_fused_density_with_barriers():
@@ -94,7 +94,7 @@ def test_fused_density_with_barriers():
     mk = lambda: ops_init.density_init_plus(1 << (2 * n), real_dtype())
     ref = np.asarray(circ.as_fn()(mk()))
     got = np.asarray(fz.as_fn()(mk()))
-    np.testing.assert_allclose(got, ref, atol=TOL)
+    np.testing.assert_allclose(got, ref, atol=TOL, rtol=TOL)
 
 
 def test_plan_counts_and_diagonal_blocks():
@@ -122,7 +122,7 @@ def test_wide_diagonal_fuses_wide_dense_passes_through():
     assert p.num_barriers == 1
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
     np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=TOL)
+                               np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
 
 
 def test_dense_blocks_are_contiguous_windows():
@@ -138,7 +138,7 @@ def test_dense_blocks_are_contiguous_windows():
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
     fz = circ.fused(max_qubits=4)
     np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=TOL)
+                               np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
 
 
 def test_fused_runs_on_qureg():
@@ -177,4 +177,4 @@ def test_fused_circuit_on_sharded_register():
     fz.run(q1)
 
     np.testing.assert_allclose(np.asarray(q8.amps), np.asarray(q1.amps),
-                               atol=TOL)
+                               atol=TOL, rtol=TOL)

@@ -50,7 +50,7 @@ def test_kernel_matches_engine_all_bit_classes():
     circ.multiRotateZ([0, 9], 0.77)
     circ.hadamard(7)
     ref = circ.as_fn()(ops_init.init_debug(1 << n, real_dtype()))
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL, rtol=TOL)
 
 
 def test_kernel_rejects_grid_bit_target():
@@ -72,7 +72,7 @@ def test_pallas_integrated_fusion_agrees(seed):
 
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
     np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=TOL)
+                               np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
 
 
 def test_density_tapes_never_use_pallas():
@@ -105,7 +105,7 @@ def test_small_register_falls_back_to_ordinary_fusion():
     assert all(f.__name__ != "_apply_pallas_run" for f, _, _ in fz._tape)
     mk = lambda: ops_init.init_debug(1 << 6, real_dtype())
     np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=TOL)
+                               np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
 
 
 def test_sharded_register_falls_back_to_engine():
@@ -132,7 +132,7 @@ def test_sharded_register_falls_back_to_engine():
     qt.initPlusState(ref)
     circ.run(ref)
     np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
-                               atol=TOL)
+                               atol=TOL, rtol=TOL)
 
 
 def test_window_dot_matches_engine():
@@ -150,13 +150,13 @@ def test_window_dot_matches_engine():
         got = PG.window_dot(amps + 0, mp, n=n, lo=lo, hi=lo + 2, interpret=True)
         ref = K.apply_matrix(amps + 0, mp, n=n,
                              targets=(lo, lo + 1, lo + 2))
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL, rtol=TOL)
         # conjugated form (density shadow)
         got_c = PG.window_dot(amps + 0, mp, n=n, lo=lo, hi=lo + 2,
                               conj=True, interpret=True)
         ref_c = K.apply_matrix(amps + 0, mp, n=n,
                                targets=(lo, lo + 1, lo + 2), conj=True)
-        np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref_c), atol=TOL)
+        np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref_c), atol=TOL, rtol=TOL)
 
 
 def test_window_alignment_in_pallas_mode():
@@ -178,4 +178,4 @@ def test_window_alignment_in_pallas_mode():
     fz = circ.fused(max_qubits=5, pallas=True)
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
     np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=TOL)
+                               np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
