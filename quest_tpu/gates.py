@@ -109,9 +109,9 @@ def _apply_gate_parity_phase(qureg: Qureg, theta, qubits, controls=()):
     qureg.put(amps)
 
 
-def _record(qureg, gate, targets, controls=(), params=()):
-    if qureg.qasm_log is not None:
-        qureg.qasm_log.record_gate(gate, targets, controls, params)
+def _log(qureg):
+    """The register's QASM logger, or None (spy registers carry none)."""
+    return qureg.qasm_log
 
 
 # ---------------------------------------------------------------------------
@@ -122,77 +122,87 @@ def phaseShift(qureg: Qureg, target: int, angle: float) -> None:
     """diag(1, e^{i angle}) on target (QuEST.h:1916)."""
     V.validate_target(qureg, target, "phaseShift")
     _apply_gate_diag(qureg, matrices.phase_shift_diag(angle), (target,))
-    _record(qureg, "phaseShift", (target,), params=(angle,))
+    if _log(qureg): _log(qureg).record_param_gate("phaseShift", target, angle)
 
 
 def controlledPhaseShift(qureg: Qureg, q1: int, q2: int, angle: float) -> None:
     """Symmetric two-qubit phase (QuEST.h:1965)."""
     V.validate_control_target(qureg, q1, q2, "controlledPhaseShift")
     _apply_gate_diag(qureg, matrices.phase_shift_diag(angle), (q2,), (q1,))
-    _record(qureg, "phaseShift", (q2,), (q1,), params=(angle,))
+    if _log(qureg): _log(qureg).record_controlled_param_gate("phaseShift", q1, q2, angle)
 
 
 def multiControlledPhaseShift(qureg: Qureg, qubits, angle: float) -> None:
     """Phase on the all-ones subspace of ``qubits`` (QuEST.h:2012)."""
     V.validate_multi_targets(qureg, qubits, "multiControlledPhaseShift")
     _apply_gate_diag(qureg, matrices.phase_shift_diag(angle), (qubits[0],), tuple(qubits[1:]))
-    _record(qureg, "phaseShift", (qubits[0],), tuple(qubits[1:]), params=(angle,))
+    if _log(qureg):
+        _log(qureg).record_multi_controlled_param_gate(
+            "phaseShift", tuple(qubits[:-1]), qubits[-1], angle)
 
 
 def controlledPhaseFlip(qureg: Qureg, q1: int, q2: int) -> None:
     V.validate_control_target(qureg, q1, q2, "controlledPhaseFlip")
     _apply_gate_diag(qureg, np.array([1.0, -1.0]), (q2,), (q1,))
-    _record(qureg, "sigmaZ", (q2,), (q1,))
+    if _log(qureg): _log(qureg).record_controlled_gate("sigmaZ", q1, q2)
 
 
 def multiControlledPhaseFlip(qureg: Qureg, qubits) -> None:
     V.validate_multi_targets(qureg, qubits, "multiControlledPhaseFlip")
     _apply_gate_diag(qureg, np.array([1.0, -1.0]), (qubits[0],), tuple(qubits[1:]))
-    _record(qureg, "sigmaZ", (qubits[0],), tuple(qubits[1:]))
+    if _log(qureg):
+        _log(qureg).record_multi_controlled_gate("sigmaZ", tuple(qubits[:-1]), qubits[-1])
 
 
 def sGate(qureg: Qureg, target: int) -> None:
     V.validate_target(qureg, target, "sGate")
     _apply_gate_diag(qureg, np.array([1.0, 1.0j]), (target,))
-    _record(qureg, "sGate", (target,))
+    if _log(qureg): _log(qureg).record_gate("sGate", target)
 
 
 def tGate(qureg: Qureg, target: int) -> None:
     V.validate_target(qureg, target, "tGate")
     _apply_gate_diag(qureg, np.array([1.0, np.exp(0.25j * math.pi)]), (target,))
-    _record(qureg, "tGate", (target,))
+    if _log(qureg): _log(qureg).record_gate("tGate", target)
 
 
 def pauliZ(qureg: Qureg, target: int) -> None:
     V.validate_target(qureg, target, "pauliZ")
     _apply_gate_diag(qureg, np.array([1.0, -1.0]), (target,))
-    _record(qureg, "sigmaZ", (target,))
+    if _log(qureg): _log(qureg).record_gate("sigmaZ", target)
 
 
 def rotateZ(qureg: Qureg, target: int, angle: float) -> None:
     V.validate_target(qureg, target, "rotateZ")
     _apply_gate_diag(qureg, matrices.rz_diag(angle), (target,))
-    _record(qureg, "rotateZ", (target,), params=(angle,))
+    if _log(qureg): _log(qureg).record_param_gate("rotateZ", target, angle)
 
 
 def controlledRotateZ(qureg: Qureg, control: int, target: int, angle: float) -> None:
     V.validate_control_target(qureg, control, target, "controlledRotateZ")
     _apply_gate_diag(qureg, matrices.rz_diag(angle), (target,), (control,))
-    _record(qureg, "rotateZ", (target,), (control,), params=(angle,))
+    if _log(qureg): _log(qureg).record_controlled_param_gate("rotateZ", control, target, angle)
 
 
 def multiRotateZ(qureg: Qureg, qubits, angle: float) -> None:
     """exp(-i angle/2 Z x...x Z) (QuEST.h:4483)."""
     V.validate_multi_targets(qureg, qubits, "multiRotateZ")
     _apply_gate_parity_phase(qureg, angle, tuple(qubits))
-    _record(qureg, "multiRotateZ", tuple(qubits), params=(angle,))
+    if _log(qureg):
+        _log(qureg).record_comment(
+            f"Here a {len(qubits)}-qubit multiRotateZ of angle "
+            f"{_log(qureg).fmt_real(angle)} was performed (QASM not yet implemented)")
 
 
 def multiControlledMultiRotateZ(qureg: Qureg, controls, targets, angle: float) -> None:
     """(QuEST.h:4616)."""
     V.validate_multi_controls_multi_targets(qureg, controls, targets, "multiControlledMultiRotateZ")
     _apply_gate_parity_phase(qureg, angle, tuple(targets), tuple(controls))
-    _record(qureg, "multiRotateZ", tuple(targets), tuple(controls), params=(angle,))
+    if _log(qureg):
+        _log(qureg).record_comment(
+            f"Here a {len(controls)}-control {len(targets)}-target "
+            f"multiControlledMultiRotateZ of angle {_log(qureg).fmt_real(angle)} "
+            "was performed (QASM not yet implemented)")
 
 
 def diagonalUnitary(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
@@ -205,7 +215,9 @@ def diagonalUnitary(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
     V._assert(bool(np.all(np.abs(np.abs(elems) - 1) < 100 * qureg.eps)),
               "The diagonal operator is not unitary.", func)
     _apply_gate_diag(qureg, elems, tuple(targets))
-    _record(qureg, "diagonal", tuple(targets))
+    if _log(qureg):
+        _log(qureg).record_comment(
+            "Here, the register was modified by an undisclosed diagonal unitary (via diagonalUnitary).")
 
 
 # ---------------------------------------------------------------------------
@@ -215,20 +227,21 @@ def diagonalUnitary(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
 def pauliX(qureg: Qureg, target: int) -> None:
     V.validate_target(qureg, target, "pauliX")
     _apply_gate_x(qureg, (target,))
-    _record(qureg, "sigmaX", (target,))
+    if _log(qureg): _log(qureg).record_gate("sigmaX", target)
 
 
 def controlledNot(qureg: Qureg, control: int, target: int) -> None:
     V.validate_control_target(qureg, control, target, "controlledNot")
     _apply_gate_x(qureg, (target,), (control,))
-    _record(qureg, "sigmaX", (target,), (control,))
+    if _log(qureg): _log(qureg).record_controlled_gate("sigmaX", control, target)
 
 
 def multiQubitNot(qureg: Qureg, targets) -> None:
     """(QuEST.h:3464)."""
     V.validate_multi_targets(qureg, targets, "multiQubitNot")
     _apply_gate_x(qureg, tuple(targets))
-    _record(qureg, "sigmaX", tuple(targets))
+    if _log(qureg):
+        _log(qureg).record_multi_controlled_multi_qubit_not((), tuple(targets))
 
 
 def multiControlledMultiQubitNot(qureg: Qureg, controls, targets) -> None:
@@ -236,7 +249,8 @@ def multiControlledMultiQubitNot(qureg: Qureg, controls, targets) -> None:
     V.validate_multi_controls_multi_targets(qureg, controls, targets,
                                             "multiControlledMultiQubitNot")
     _apply_gate_x(qureg, tuple(targets), tuple(controls))
-    _record(qureg, "sigmaX", tuple(targets), tuple(controls))
+    if _log(qureg):
+        _log(qureg).record_multi_controlled_multi_qubit_not(tuple(controls), tuple(targets))
 
 
 # ---------------------------------------------------------------------------
@@ -246,19 +260,19 @@ def multiControlledMultiQubitNot(qureg: Qureg, controls, targets) -> None:
 def hadamard(qureg: Qureg, target: int) -> None:
     V.validate_target(qureg, target, "hadamard")
     _apply_gate_matrix(qureg, matrices.HADAMARD, (target,))
-    _record(qureg, "hadamard", (target,))
+    if _log(qureg): _log(qureg).record_gate("hadamard", target)
 
 
 def pauliY(qureg: Qureg, target: int) -> None:
     V.validate_target(qureg, target, "pauliY")
     _apply_gate_matrix(qureg, matrices.PAULI_Y_M, (target,))
-    _record(qureg, "sigmaY", (target,))
+    if _log(qureg): _log(qureg).record_gate("sigmaY", target)
 
 
 def controlledPauliY(qureg: Qureg, control: int, target: int) -> None:
     V.validate_control_target(qureg, control, target, "controlledPauliY")
     _apply_gate_matrix(qureg, matrices.PAULI_Y_M, (target,), (control,))
-    _record(qureg, "sigmaY", (target,), (control,))
+    if _log(qureg): _log(qureg).record_controlled_gate("sigmaY", control, target)
 
 
 def compactUnitary(qureg: Qureg, target: int, alpha: complex, beta: complex) -> None:
@@ -267,7 +281,7 @@ def compactUnitary(qureg: Qureg, target: int, alpha: complex, beta: complex) -> 
     V.validate_target(qureg, target, func)
     V.validate_unitary_complex_pair(alpha, beta, qureg.eps, func)
     _apply_gate_matrix(qureg, matrices.compact_unitary_matrix(alpha, beta), (target,))
-    _record(qureg, "unitary", (target,))
+    if _log(qureg): _log(qureg).record_compact_unitary(alpha, beta, target)
 
 
 def controlledCompactUnitary(qureg: Qureg, control: int, target: int,
@@ -277,7 +291,7 @@ def controlledCompactUnitary(qureg: Qureg, control: int, target: int,
     V.validate_unitary_complex_pair(alpha, beta, qureg.eps, func)
     _apply_gate_matrix(qureg, matrices.compact_unitary_matrix(alpha, beta),
                        (target,), (control,))
-    _record(qureg, "unitary", (target,), (control,))
+    if _log(qureg): _log(qureg).record_controlled_compact_unitary(alpha, beta, control, target)
 
 
 def unitary(qureg: Qureg, target: int, u) -> None:
@@ -285,7 +299,7 @@ def unitary(qureg: Qureg, target: int, u) -> None:
     V.validate_target(qureg, target, func)
     V.validate_unitary_matrix(u, 1, qureg.eps, func)
     _apply_gate_matrix(qureg, u, (target,))
-    _record(qureg, "unitary", (target,))
+    if _log(qureg): _log(qureg).record_unitary(np.asarray(u), target)
 
 
 def controlledUnitary(qureg: Qureg, control: int, target: int, u) -> None:
@@ -293,7 +307,7 @@ def controlledUnitary(qureg: Qureg, control: int, target: int, u) -> None:
     V.validate_control_target(qureg, control, target, func)
     V.validate_unitary_matrix(u, 1, qureg.eps, func)
     _apply_gate_matrix(qureg, u, (target,), (control,))
-    _record(qureg, "unitary", (target,), (control,))
+    if _log(qureg): _log(qureg).record_controlled_unitary(np.asarray(u), control, target)
 
 
 def multiControlledUnitary(qureg: Qureg, controls, target: int, u) -> None:
@@ -301,7 +315,7 @@ def multiControlledUnitary(qureg: Qureg, controls, target: int, u) -> None:
     V.validate_multi_controls_multi_targets(qureg, controls, (target,), func)
     V.validate_unitary_matrix(u, 1, qureg.eps, func)
     _apply_gate_matrix(qureg, u, (target,), tuple(controls))
-    _record(qureg, "unitary", (target,), tuple(controls))
+    if _log(qureg): _log(qureg).record_multi_controlled_unitary(np.asarray(u), tuple(controls), target)
 
 
 def multiStateControlledUnitary(qureg: Qureg, controls, states, target: int, u) -> None:
@@ -311,7 +325,9 @@ def multiStateControlledUnitary(qureg: Qureg, controls, states, target: int, u) 
     V.validate_control_state(states, len(controls), func)
     V.validate_unitary_matrix(u, 1, qureg.eps, func)
     _apply_gate_matrix(qureg, u, (target,), tuple(controls), tuple(int(s) for s in states))
-    _record(qureg, "unitary", (target,), tuple(controls))
+    if _log(qureg):
+        _log(qureg).record_multi_state_controlled_unitary(
+            np.asarray(u), tuple(controls), tuple(int(s) for s in states), target)
 
 
 # ---------------------------------------------------------------------------
@@ -321,13 +337,13 @@ def multiStateControlledUnitary(qureg: Qureg, controls, states, target: int, u) 
 def rotateX(qureg: Qureg, target: int, angle: float) -> None:
     V.validate_target(qureg, target, "rotateX")
     _apply_gate_matrix(qureg, matrices.rx_matrix(angle), (target,))
-    _record(qureg, "rotateX", (target,), params=(angle,))
+    if _log(qureg): _log(qureg).record_param_gate("rotateX", target, angle)
 
 
 def rotateY(qureg: Qureg, target: int, angle: float) -> None:
     V.validate_target(qureg, target, "rotateY")
     _apply_gate_matrix(qureg, matrices.ry_matrix(angle), (target,))
-    _record(qureg, "rotateY", (target,), params=(angle,))
+    if _log(qureg): _log(qureg).record_param_gate("rotateY", target, angle)
 
 
 def rotateAroundAxis(qureg: Qureg, target: int, angle: float, axis: Vector) -> None:
@@ -335,19 +351,19 @@ def rotateAroundAxis(qureg: Qureg, target: int, angle: float, axis: Vector) -> N
     V.validate_target(qureg, target, func)
     V.validate_vector(axis, func)
     _apply_gate_matrix(qureg, matrices.rotation_matrix(angle, axis), (target,))
-    _record(qureg, "unitary", (target,))
+    if _log(qureg): _log(qureg).record_axis_rotation(angle, axis, target)
 
 
 def controlledRotateX(qureg: Qureg, control: int, target: int, angle: float) -> None:
     V.validate_control_target(qureg, control, target, "controlledRotateX")
     _apply_gate_matrix(qureg, matrices.rx_matrix(angle), (target,), (control,))
-    _record(qureg, "rotateX", (target,), (control,), params=(angle,))
+    if _log(qureg): _log(qureg).record_controlled_param_gate("rotateX", control, target, angle)
 
 
 def controlledRotateY(qureg: Qureg, control: int, target: int, angle: float) -> None:
     V.validate_control_target(qureg, control, target, "controlledRotateY")
     _apply_gate_matrix(qureg, matrices.ry_matrix(angle), (target,), (control,))
-    _record(qureg, "rotateY", (target,), (control,), params=(angle,))
+    if _log(qureg): _log(qureg).record_controlled_param_gate("rotateY", control, target, angle)
 
 
 def controlledRotateAroundAxis(qureg: Qureg, control: int, target: int,
@@ -356,7 +372,7 @@ def controlledRotateAroundAxis(qureg: Qureg, control: int, target: int,
     V.validate_control_target(qureg, control, target, func)
     V.validate_vector(axis, func)
     _apply_gate_matrix(qureg, matrices.rotation_matrix(angle, axis), (target,), (control,))
-    _record(qureg, "unitary", (target,), (control,))
+    if _log(qureg): _log(qureg).record_controlled_axis_rotation(angle, axis, control, target)
 
 
 def multiRotatePauli(qureg: Qureg, targets, paulis, angle: float) -> None:
@@ -394,7 +410,16 @@ def _multi_rotate_pauli(qureg, controls, targets, paulis, angle, func):
     for t, c in active:
         if c in matrices.BASIS_TO_Z:
             _apply_gate_matrix(qureg, np.conj(matrices.BASIS_TO_Z[c]).T, (t,))
-    _record(qureg, "multiRotatePauli", tuple(targets), tuple(controls), params=(angle,))
+    if _log(qureg):
+        if controls:
+            _log(qureg).record_comment(
+                f"Here a {len(controls)}-control {len(targets)}-target "
+                f"multiControlledMultiRotatePauli of angle {_log(qureg).fmt_real(angle)} "
+                "was performed (QASM not yet implemented)")
+        else:
+            _log(qureg).record_comment(
+                f"Here a {len(targets)}-qubit multiRotatePauli of angle "
+                f"{_log(qureg).fmt_real(angle)} was performed (QASM not yet implemented)")
 
 
 # ---------------------------------------------------------------------------
@@ -412,13 +437,13 @@ def swapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
     if qureg.is_density_matrix:
         amps = apply(amps, n=nsv, qb1=qb1 + n, qb2=qb2 + n)
     qureg.put(amps)
-    _record(qureg, "swap", (qb1, qb2))
+    if _log(qureg): _log(qureg).record_controlled_gate("swap", qb1, qb2)
 
 
 def sqrtSwapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
     V.validate_unique_targets(qureg, qb1, qb2, "sqrtSwapGate")
     _apply_gate_matrix(qureg, matrices.SQRT_SWAP, (qb1, qb2))
-    _record(qureg, "sqrtSwap", (qb1, qb2))
+    if _log(qureg): _log(qureg).record_controlled_gate("sqrtSwap", qb1, qb2)
 
 
 def twoQubitUnitary(qureg: Qureg, t1: int, t2: int, u) -> None:
@@ -427,7 +452,8 @@ def twoQubitUnitary(qureg: Qureg, t1: int, t2: int, u) -> None:
     V.validate_multi_targets(qureg, (t1, t2), func)
     V.validate_unitary_matrix(u, 2, qureg.eps, func)
     _apply_gate_matrix(qureg, u, (t1, t2))
-    _record(qureg, "unitary", (t1, t2))
+    if _log(qureg):
+        _log(qureg).record_comment("Here, an undisclosed 2-qubit unitary was applied.")
 
 
 def controlledTwoQubitUnitary(qureg: Qureg, control: int, t1: int, t2: int, u) -> None:
@@ -435,7 +461,8 @@ def controlledTwoQubitUnitary(qureg: Qureg, control: int, t1: int, t2: int, u) -
     V.validate_multi_controls_multi_targets(qureg, (control,), (t1, t2), func)
     V.validate_unitary_matrix(u, 2, qureg.eps, func)
     _apply_gate_matrix(qureg, u, (t1, t2), (control,))
-    _record(qureg, "unitary", (t1, t2), (control,))
+    if _log(qureg):
+        _log(qureg).record_comment("Here, an undisclosed controlled 2-qubit unitary was applied.")
 
 
 def multiControlledTwoQubitUnitary(qureg: Qureg, controls, t1: int, t2: int, u) -> None:
@@ -443,7 +470,8 @@ def multiControlledTwoQubitUnitary(qureg: Qureg, controls, t1: int, t2: int, u) 
     V.validate_multi_controls_multi_targets(qureg, controls, (t1, t2), func)
     V.validate_unitary_matrix(u, 2, qureg.eps, func)
     _apply_gate_matrix(qureg, u, (t1, t2), tuple(controls))
-    _record(qureg, "unitary", (t1, t2), tuple(controls))
+    if _log(qureg):
+        _log(qureg).record_comment("Here, an undisclosed multi-controlled 2-qubit unitary was applied.")
 
 
 def multiQubitUnitary(qureg: Qureg, targets, u) -> None:
@@ -452,7 +480,8 @@ def multiQubitUnitary(qureg: Qureg, targets, u) -> None:
     V.validate_multi_targets(qureg, targets, func)
     V.validate_unitary_matrix(u, len(targets), qureg.eps, func)
     _apply_gate_matrix(qureg, u, tuple(targets))
-    _record(qureg, "unitary", tuple(targets))
+    if _log(qureg):
+        _log(qureg).record_comment("Here, an undisclosed multi-qubit unitary was applied.")
 
 
 def controlledMultiQubitUnitary(qureg: Qureg, control: int, targets, u) -> None:
@@ -460,7 +489,8 @@ def controlledMultiQubitUnitary(qureg: Qureg, control: int, targets, u) -> None:
     V.validate_multi_controls_multi_targets(qureg, (control,), targets, func)
     V.validate_unitary_matrix(u, len(targets), qureg.eps, func)
     _apply_gate_matrix(qureg, u, tuple(targets), (control,))
-    _record(qureg, "unitary", tuple(targets), (control,))
+    if _log(qureg):
+        _log(qureg).record_comment("Here, an undisclosed controlled multi-qubit unitary was applied.")
 
 
 def multiControlledMultiQubitUnitary(qureg: Qureg, controls, targets, u) -> None:
@@ -469,7 +499,8 @@ def multiControlledMultiQubitUnitary(qureg: Qureg, controls, targets, u) -> None
     V.validate_multi_controls_multi_targets(qureg, controls, targets, func)
     V.validate_unitary_matrix(u, len(targets), qureg.eps, func)
     _apply_gate_matrix(qureg, u, tuple(targets), tuple(controls))
-    _record(qureg, "unitary", tuple(targets), tuple(controls))
+    if _log(qureg):
+        _log(qureg).record_comment("Here, an undisclosed multi-controlled multi-qubit unitary was applied.")
 
 
 # ---------------------------------------------------------------------------
@@ -505,7 +536,8 @@ def collapseToOutcome(qureg: Qureg, target: int, outcome: int) -> float:
     V._assert(prob > qureg.eps, "Can't collapse to state with zero probability.", func)
     _collapse(qureg, target, outcome, prob)
     if qureg.qasm_log is not None:
-        qureg.qasm_log.record_comment(f"collapseToOutcome {outcome} on q[{target}]")
+        qureg.qasm_log.record_comment(
+            f"Here, qubit {target} was un-physically projected into outcome {outcome}")
     return prob
 
 
@@ -518,15 +550,21 @@ def measureWithStats(qureg: Qureg, target: int):
     """
     V.validate_target(qureg, target, "measureWithStats")
     zero_prob = _prob_of_outcome(qureg, target, 0)
-    # generateMeasurementOutcome: draw in [0,1), outcome 1 iff draw >= P(0)
-    draw = qureg.env.rng.random_sample() if qureg.env.rng is not None else np.random.random()
-    if zero_prob < 1e-16:
-        outcome, prob = 1, 1 - zero_prob
-    elif zero_prob > 1 - 1e-16:
-        outcome, prob = 0, zero_prob
+    # generateMeasurementOutcome (QuEST_common.c:168-183): REAL_EPS-scaled
+    # cutoffs (precision-dependent, not absolute -- in f32 a zero-probability
+    # branch sits well above 1e-16 of noise), and the RNG is consumed only
+    # when the outcome is genuinely random, keeping the stream aligned with
+    # the reference's across deterministic measurements.
+    eps = qureg.eps
+    if zero_prob < eps:
+        outcome = 1
+    elif 1 - zero_prob < eps:
+        outcome = 0
     else:
-        outcome = int(draw >= zero_prob)
-        prob = zero_prob if outcome == 0 else 1 - zero_prob
+        draw = (qureg.env.rng.random_sample() if qureg.env.rng is not None
+                else np.random.random())
+        outcome = int(draw > zero_prob)
+    prob = zero_prob if outcome == 0 else 1 - zero_prob
     _collapse(qureg, target, outcome, prob)
     if qureg.qasm_log is not None:
         qureg.qasm_log.record_measurement(target)

@@ -2,29 +2,92 @@
 
 Pure host-side string accumulation, one logger per Qureg. The reference keeps
 a growable char buffer (1 KiB, x2 growth, QuEST_qasm.c:35-107); Python lists
-make that machinery unnecessary, but the recorded text format follows the
-reference: the OPENQASM header (``:69-77``), the gate-name table (``:40-54``),
-one-control gates as ``c<name>``, and explanatory comments for operations that
-QASM 2.0 cannot express (multi-controlled gates, decoherence, init etc. --
-the reference does the same, e.g. QuEST.c:670-674).
+make that machinery unnecessary, but the recorded *text* mirrors the
+reference byte-for-byte:
+
+- the OPENQASM header (QuEST_qasm.c:69-77) and gate-name table (:40-54);
+- one ``c`` prefix per control qubit (CTRL_LABEL_PREF, addGateToQASM
+  :133-173) -- so multi-controlled gates print ``ccU(...) q[a],q[b],q[t];``;
+- ``unitary``/``compactUnitary``/``rotateAroundAxis`` and their controlled
+  variants are decomposed to ZYZ angles and logged as ``U(rz2,ry,rz1)``
+  (qasm_recordCompactUnitary / qasm_recordUnitary / qasm_recordAxisRotation,
+  QuEST_qasm.c:191-310; angle math getZYZRotAnglesFromComplexPair and
+  getComplexPairAndPhaseFromUnitary, QuEST_common.c:130-153);
+- the global phase discarded by QASM's U(a,b,c) for *controlled* unitaries
+  and controlled phase shifts is restored by a trailing ``Rz`` on the target
+  plus an explanatory comment (QuEST_qasm.c:244-259,276-294,336-356);
+- controls-on-0 are wrapped in NOTs (qasm_recordMultiStateControlledUnitary,
+  QuEST_qasm.c:358-376);
+- numbers are printed with REAL_QASM_FORMAT: %.8g single / %.14g double
+  precision (QuEST_precision.h:47,62);
+- operations QASM 2.0 cannot express become comments with the reference's
+  exact wording (e.g. QuEST.c:670-674).
 """
 
 from __future__ import annotations
 
+import math
+
+import numpy as np
+
+from . import precision
 
 #: gate-name table, mirroring qasmGateLabels (QuEST_qasm.c:40-54)
 GATE_QASM_LABELS = {
     "sigmaX": "x", "sigmaY": "y", "sigmaZ": "z",
     "tGate": "t", "sGate": "s", "hadamard": "h",
     "rotateX": "Rx", "rotateY": "Ry", "rotateZ": "Rz",
-    "unitary": "U", "phaseShift": "Rz", "swap": "swap", "sqrtSwap": "srswap",
+    "unitary": "U", "phaseShift": "Rz", "swap": "swap", "sqrtSwap": "sqrtswap",
 }
 
 
+# ---------------------------------------------------------------------------
+# decomposition helpers (QuEST_common.c:120-153)
+# ---------------------------------------------------------------------------
+
+def zyz_angles_from_complex_pair(alpha: complex, beta: complex):
+    """U(alpha, beta) = Rz(rz2) Ry(ry) Rz(rz1), as
+    getZYZRotAnglesFromComplexPair (QuEST_common.c:130-139)."""
+    alpha, beta = complex(alpha), complex(beta)
+    alpha_mag = abs(alpha)
+    ry = 2.0 * math.acos(min(alpha_mag, 1.0))
+    alpha_phase = math.atan2(alpha.imag, alpha.real)
+    beta_phase = math.atan2(beta.imag, beta.real)
+    rz2 = -alpha_phase + beta_phase
+    rz1 = -alpha_phase - beta_phase
+    return rz2, ry, rz1
+
+
+def complex_pair_and_phase_from_unitary(u):
+    """u = exp(i globalPhase) [[alpha, -conj(beta)], [beta, conj(alpha)]], as
+    getComplexPairAndPhaseFromUnitary (QuEST_common.c:142-153)."""
+    u = np.asarray(u, dtype=complex)
+    r0c0_phase = math.atan2(u[0, 0].imag, u[0, 0].real)
+    r1c1_phase = math.atan2(u[1, 1].imag, u[1, 1].real)
+    global_phase = (r0c0_phase + r1c1_phase) / 2.0
+    rot = complex(math.cos(global_phase), -math.sin(global_phase))
+    alpha = u[0, 0] * rot
+    beta = u[1, 0] * rot
+    return alpha, beta, global_phase
+
+
+def complex_pair_from_rotation(angle, axis):
+    """Axis rotation -> (alpha, beta), as getComplexPairFromRotation
+    (QuEST_common.c:120-127); delegates to the one implementation in
+    :mod:`.matrices` so the QASM log always matches the applied gate."""
+    from .matrices import rotation_around_axis_pair
+
+    return rotation_around_axis_pair(angle, axis)
+
+
 class QASMLogger:
-    def __init__(self, num_qubits: int):
+    def __init__(self, num_qubits: int, dtype=None):
         self.num_qubits = num_qubits
         self.recording = False
+        # REAL_QASM_FORMAT: %.8g single / %.14g double (QuEST_precision.h)
+        prec = (precision.precision_for_dtype(dtype) if dtype is not None
+                else precision.default_precision())
+        self._fmt = "%.8g" if prec == 1 else "%.14g"
         self._lines: list[str] = []
         self._write_header()
 
@@ -53,42 +116,175 @@ class QASMLogger:
         with open(filename, "w") as f:
             f.write(self.printed())
 
-    # -- recording ----------------------------------------------------------
+    # -- low-level line assembly (addGateToQASM, QuEST_qasm.c:133-173) ------
 
-    def _fmt_params(self, params) -> str:
-        if not params:
-            return ""
-        return "(" + ",".join(f"{float(p):g}" for p in params) + ")"
+    def _num(self, p) -> str:
+        return self._fmt % float(p)
 
-    def record_gate(self, gate: str, targets, controls=(), params=()):
-        """Record one gate application. Gates with 0 or 1 controls map to QASM
-        (``h q[0];`` / ``ch q[1],q[0];``); others become comments, as the
-        reference's qasm_recordMultiControlledGate fallback."""
+    def _add_gate(self, gate: str, controls, target, params=()):
+        label = GATE_QASM_LABELS.get(gate, gate)
+        line = "c" * len(controls) + label
+        if params:
+            line += "(" + ",".join(self._num(p) for p in params) + ")"
+        line += " " + "".join(f"q[{c}]," for c in controls) + f"q[{int(target)}];"
+        self._lines.append(line)
+
+    # -- gate records (qasm_record*, QuEST_qasm.c:175-426) ------------------
+
+    def record_gate(self, gate: str, target: int):
+        if self.recording:
+            self._add_gate(gate, (), target)
+
+    def record_param_gate(self, gate: str, target: int, param: float):
+        if self.recording:
+            self._add_gate(gate, (), target, (param,))
+
+    def record_compact_unitary(self, alpha, beta, target: int):
         if not self.recording:
             return
-        label = GATE_QASM_LABELS.get(gate, gate)
-        p = self._fmt_params(params)
-        qubits = list(controls) + list(targets)
-        args = ",".join(f"q[{q}]" for q in qubits)
-        if len(controls) == 0:
-            self._lines.append(f"{label}{p} {args};")
-        elif len(controls) == 1:
-            self._lines.append(f"c{label}{p} {args};")
-        else:
-            self._lines.append(
-                f"// {len(controls)}-controlled {label}{p} on {args} "
-                "(not expressible in QASM 2.0)")
+        rz2, ry, rz1 = zyz_angles_from_complex_pair(alpha, beta)
+        self._add_gate("unitary", (), target, (rz2, ry, rz1))
+
+    def record_unitary(self, u, target: int):
+        if not self.recording:
+            return
+        alpha, beta, _ = complex_pair_and_phase_from_unitary(u)
+        rz2, ry, rz1 = zyz_angles_from_complex_pair(alpha, beta)
+        self._add_gate("unitary", (), target, (rz2, ry, rz1))
+
+    def record_axis_rotation(self, angle, axis, target: int):
+        if not self.recording:
+            return
+        alpha, beta = complex_pair_from_rotation(angle, axis)
+        rz2, ry, rz1 = zyz_angles_from_complex_pair(alpha, beta)
+        self._add_gate("unitary", (), target, (rz2, ry, rz1))
+
+    def record_controlled_gate(self, gate: str, control: int, target: int):
+        if self.recording:
+            self._add_gate(gate, (control,), target)
+
+    def record_controlled_param_gate(self, gate: str, control: int,
+                                     target: int, param: float):
+        if not self.recording:
+            return
+        self._add_gate(gate, (control,), target, (param,))
+        # correct the global phase of controlled phase shifts
+        # (qasm_recordControlledParamGate, QuEST_qasm.c:244-259)
+        if gate == "phaseShift":
+            self.record_comment("Restoring the discarded global phase of the "
+                                "previous controlled phase gate")
+            self._add_gate("rotateZ", (), target, (param / 2.0,))
+
+    def record_controlled_compact_unitary(self, alpha, beta,
+                                          control: int, target: int):
+        if not self.recording:
+            return
+        rz2, ry, rz1 = zyz_angles_from_complex_pair(alpha, beta)
+        self._add_gate("unitary", (control,), target, (rz2, ry, rz1))
+
+    def record_controlled_unitary(self, u, control: int, target: int):
+        """Additionally performs Rz on target to restore the global phase lost
+        from u in QASM U(a,b,c) (qasm_recordControlledUnitary)."""
+        if not self.recording:
+            return
+        self.record_multi_controlled_unitary(u, (control,), target,
+                                             _kind="controlled")
+
+    def record_controlled_axis_rotation(self, angle, axis,
+                                        control: int, target: int):
+        if not self.recording:
+            return
+        alpha, beta = complex_pair_from_rotation(angle, axis)
+        rz2, ry, rz1 = zyz_angles_from_complex_pair(alpha, beta)
+        self._add_gate("unitary", (control,), target, (rz2, ry, rz1))
+
+    def record_multi_controlled_gate(self, gate: str, controls, target: int):
+        if self.recording:
+            self._add_gate(gate, tuple(controls), target)
+
+    def record_multi_controlled_param_gate(self, gate: str, controls,
+                                           target: int, param: float):
+        if not self.recording:
+            return
+        self._add_gate(gate, tuple(controls), target, (param,))
+        if gate == "phaseShift":
+            self.record_comment("Restoring the discarded global phase of the "
+                                "previous multicontrolled phase gate")
+            self._add_gate("rotateZ", (), target, (param / 2.0,))
+
+    def record_multi_controlled_unitary(self, u, controls, target: int,
+                                        _kind: str = "multicontrolled"):
+        if not self.recording:
+            return
+        alpha, beta, global_phase = complex_pair_and_phase_from_unitary(u)
+        rz2, ry, rz1 = zyz_angles_from_complex_pair(alpha, beta)
+        self._add_gate("unitary", tuple(controls), target, (rz2, ry, rz1))
+        self.record_comment("Restoring the discarded global phase of the "
+                            f"previous {_kind} unitary")
+        self._add_gate("rotateZ", (), target, (global_phase,))
+
+    def record_multi_state_controlled_unitary(self, u, controls, states,
+                                              target: int):
+        """Controls-on-0 wrapped in NOTs
+        (qasm_recordMultiStateControlledUnitary, QuEST_qasm.c:358-376)."""
+        if not self.recording:
+            return
+        self.record_comment(
+            "NOTing some gates so that the subsequent unitary is controlled-on-0")
+        for c, s in zip(controls, states):
+            if s == 0:
+                self._add_gate("sigmaX", (), c)
+        self.record_multi_controlled_unitary(u, controls, target)
+        self.record_comment(
+            "Undoing the NOTing of the controlled-on-0 qubits of the previous unitary")
+        for c, s in zip(controls, states):
+            if s == 0:
+                self._add_gate("sigmaX", (), c)
+
+    def record_multi_controlled_multi_qubit_not(self, controls, targets):
+        """(qasm_recordMultiControlledMultiQubitNot, QuEST_qasm.c:378-388)."""
+        if not self.recording:
+            return
+        name = ("multiControlledMultiQubitNot" if controls
+                else "multiQubitNot")
+        self.record_comment(
+            f"The following {len(targets)} gates resulted from a single "
+            f"{name}() call")
+        for t in targets:
+            self._add_gate("sigmaX", tuple(controls), t)
 
     def record_measurement(self, target: int):
         if self.recording:
             self._lines.append(f"measure q[{target}] -> c[{target}];")
 
+    # -- init records (QuEST_qasm.c:438-480) --------------------------------
+
     def record_init_zero(self):
+        """INIT_ZERO_CMD: ``reset q;`` (QuEST_qasm.c:33,470-480)."""
         if self.recording:
-            self._lines.append("// Initialised zero state")
+            self._lines.append("reset q;")
+
+    def record_init_plus(self):
+        if self.recording:
+            self.record_comment("Initialising state |+>")
+            self.record_init_zero()
+            self._lines.append("h q;")
+
+    def record_init_classical(self, state_index: int):
+        if not self.recording:
+            return
+        self.record_comment(f"Initialising state |{int(state_index)}>")
+        self.record_init_zero()
+        for q in range(self.num_qubits):
+            if (int(state_index) >> q) & 1:
+                self._add_gate("sigmaX", (), q)
 
     def record_comment(self, comment: str):
         """qasm_recordComment (QuEST_qasm.c): used for every op QASM cannot
         express -- init, decoherence, phase functions, QFT internals etc."""
         if self.recording:
             self._lines.append(f"// {comment}")
+
+    def fmt_real(self, value: float) -> str:
+        """REAL_QASM_FORMAT rendering for comment text interpolation."""
+        return self._num(value)

@@ -101,7 +101,7 @@ def createQureg(num_qubits: int, env: QuESTEnv, precision_code: int | None = Non
     validation.validate_num_qubits(num_qubits, func)
     dtype = precision.real_dtype(precision_code)
     q = Qureg(num_qubits, False, _alloc(env, num_qubits, dtype), env)
-    q.qasm_log = QASMLogger(num_qubits)
+    q.qasm_log = QASMLogger(num_qubits, dtype)
     return q
 
 
@@ -112,7 +112,7 @@ def createDensityQureg(num_qubits: int, env: QuESTEnv, precision_code: int | Non
     validation._assert(num_qubits < 32, "Invalid number of qubits. The given number of qubits cannot be stored.", func)
     dtype = precision.real_dtype(precision_code)
     q = Qureg(num_qubits, True, _alloc(env, 2 * num_qubits, dtype), env)
-    q.qasm_log = QASMLogger(num_qubits)
+    q.qasm_log = QASMLogger(num_qubits, dtype)
     return q
 
 
@@ -120,7 +120,7 @@ def createCloneQureg(qureg: Qureg, env: QuESTEnv) -> Qureg:
     """Deep copy (createCloneQureg, QuEST.h:694)."""
     q = Qureg(qureg.num_qubits_represented, qureg.is_density_matrix,
               qureg.amps + 0, env)
-    q.qasm_log = QASMLogger(qureg.num_qubits_represented)
+    q.qasm_log = QASMLogger(qureg.num_qubits_represented, qureg.dtype)
     return q
 
 

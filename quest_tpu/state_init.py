@@ -31,7 +31,9 @@ def _put_shaped(qureg: Qureg, amps) -> None:
 def initBlankState(qureg: Qureg) -> None:
     """All-zero amplitudes (unnormalised) (QuEST.h:1619)."""
     _put_shaped(qureg, I.init_blank(qureg.num_amps_total, qureg.dtype))
-    if qureg.qasm_log: qureg.qasm_log.record_comment("initBlankState")
+    if qureg.qasm_log:
+        qureg.qasm_log.record_comment(
+            "Here, the register was initialised to an unphysical all-zero-amplitudes 'state'.")
 
 
 def initZeroState(qureg: Qureg) -> None:
@@ -49,7 +51,7 @@ def initPlusState(qureg: Qureg) -> None:
     else:
         amps = I.init_plus(qureg.num_amps_total, qureg.dtype)
     _put_shaped(qureg, amps)
-    if qureg.qasm_log: qureg.qasm_log.record_comment("initPlusState")
+    if qureg.qasm_log: qureg.qasm_log.record_init_plus()
 
 
 def initClassicalState(qureg: Qureg, state_index: int) -> None:
@@ -60,7 +62,7 @@ def initClassicalState(qureg: Qureg, state_index: int) -> None:
     else:
         amps = I.init_classical(qureg.num_amps_total, qureg.dtype, state_index)
     _put_shaped(qureg, amps)
-    if qureg.qasm_log: qureg.qasm_log.record_comment(f"initClassicalState |{state_index}>")
+    if qureg.qasm_log: qureg.qasm_log.record_init_classical(state_index)
 
 
 def initPureState(qureg: Qureg, pure: Qureg) -> None:
@@ -74,7 +76,9 @@ def initPureState(qureg: Qureg, pure: Qureg) -> None:
     else:
         amps = pure.amps + 0
     _put_shaped(qureg, amps)
-    if qureg.qasm_log: qureg.qasm_log.record_comment("initPureState")
+    if qureg.qasm_log:
+        qureg.qasm_log.record_comment(
+            "Here, the register was initialised to an undisclosed given pure state.")
 
 
 def initDebugState(qureg: Qureg) -> None:
@@ -91,7 +95,9 @@ def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
     V._assert(reals.size == qureg.num_amps_total and imags.size == qureg.num_amps_total,
               "Invalid number of amplitudes. Must match the register size.", func)
     _put_shaped(qureg, jnp.asarray(np.stack([reals, imags]), dtype=qureg.dtype))
-    if qureg.qasm_log: qureg.qasm_log.record_comment("initStateFromAmps")
+    if qureg.qasm_log:
+        qureg.qasm_log.record_comment(
+            "Here, the register was initialised to an undisclosed given pure state.")
 
 
 def setAmps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
@@ -103,6 +109,9 @@ def setAmps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
                      np.asarray(imags).reshape(-1)[:num_amps]])
     qureg.put(qureg.amps.at[:, start_ind:start_ind + num_amps].set(
         jnp.asarray(vals, dtype=qureg.dtype)))
+    if qureg.qasm_log:
+        qureg.qasm_log.record_comment(
+            "Here, some amplitudes in the statevector were manually edited.")
 
 
 def setDensityAmps(qureg: Qureg, start_row: int, start_col: int, reals, imags, num_amps: int) -> None:
@@ -121,6 +130,9 @@ def setDensityAmps(qureg: Qureg, start_row: int, start_col: int, reals, imags, n
                      np.asarray(imags).reshape(-1)[:num_amps]])
     qureg.put(qureg.amps.at[:, start:start + num_amps].set(
         jnp.asarray(vals, dtype=qureg.dtype)))
+    if qureg.qasm_log:
+        qureg.qasm_log.record_comment(
+            "Here, some amplitudes in the density matrix were manually edited.")
 
 
 def cloneQureg(target: Qureg, source: Qureg) -> None:

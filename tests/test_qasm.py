@@ -1,15 +1,40 @@
 """QASM logger tests (quest_tpu/qasm.py; reference QuEST_qasm.c + the
-startRecordingQASM..writeRecordedQASMToFile API, QuEST.h:3906-3965)."""
+startRecordingQASM..writeRecordedQASMToFile API, QuEST.h:3906-3965).
+
+The recorded text must match the reference's output for the same calls:
+gate labels from qasmGateLabels (QuEST_qasm.c:40-54), one ``c`` prefix per
+control, ZYZ-decomposed ``U(rz2,ry,rz1)`` for unitary/compactUnitary/
+rotateAroundAxis (QuEST_qasm.c:191-310), and global-phase-restoring ``Rz``
+lines after controlled unitaries / controlled phase shifts.
+"""
+
+import math
 
 import numpy as np
+import pytest
 
 import quest_tpu as qt
+from quest_tpu import qasm
 
 ENV = qt.createQuESTEnv()
 
 
 def _recorded(qureg):
     return qureg.qasm_log.printed()
+
+
+def _zyz_matrix(rz2, ry, rz1):
+    """Rz(rz2) Ry(ry) Rz(rz1) as a dense 2x2 (the QASM U semantics used by
+    the reference's decomposition, QuEST_common.c:130-139)."""
+
+    def rz(t):
+        return np.diag([np.exp(-0.5j * t), np.exp(0.5j * t)])
+
+    def ryy(t):
+        c, s = math.cos(t / 2), math.sin(t / 2)
+        return np.array([[c, -s], [s, c]])
+
+    return rz(rz2) @ ryy(ry) @ rz(rz1)
 
 
 def test_header_and_basic_gates():
@@ -30,15 +55,137 @@ def test_header_and_basic_gates():
 
 
 def test_controlled_and_multi_controlled():
+    """Controls are rendered as one 'c' prefix per control qubit, exactly as
+    addGateToQASM (QuEST_qasm.c:139-141) -- including >1 controls."""
     q = qt.createQureg(4, ENV)
     qt.startRecordingQASM(q)
     qt.controlledNot(q, 0, 1)
     qt.multiControlledPhaseFlip(q, [0, 1, 2])
+    qt.controlledPhaseFlip(q, 2, 3)
     qt.stopRecordingQASM(q)
     text = _recorded(q)
-    assert "cx q[0],q[1];" in text or "csigmaX q[0],q[1];" in text.replace(" ", " ")
-    # multi-controlled ops fall back to comments, as the reference
-    assert "//" in text
+    assert "cx q[0],q[1];" in text
+    # multiControlledPhaseFlip: last listed qubit is the QASM target
+    # (QuEST.c:606 passes controlQubits[numControlQubits-1] as target)
+    assert "ccz q[0],q[1],q[2];" in text
+    assert "cz q[2],q[3];" in text
+
+
+def test_swap_labels():
+    q = qt.createQureg(3, ENV)
+    qt.startRecordingQASM(q)
+    qt.swapGate(q, 0, 2)
+    qt.sqrtSwapGate(q, 1, 2)
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    # the reference logs swaps through qasm_recordControlledGate -> 'c'+label
+    # (QuEST.c:644,657 with qasmGateLabels[GATE_SWAP]="swap")
+    assert "cswap q[0],q[2];" in text
+    assert "csqrtswap q[1],q[2];" in text
+
+
+def test_unitary_zyz_params_valid_and_roundtrip():
+    """unitary() must log U(rz2,ry,rz1) whose ZYZ product reproduces the
+    matrix up to global phase (qasm_recordUnitary, QuEST_qasm.c:203-217)."""
+    rng = np.random.RandomState(7)
+    a = rng.randn(2, 2) + 1j * rng.randn(2, 2)
+    u, _ = np.linalg.qr(a)
+    q = qt.createQureg(2, ENV)
+    qt.startRecordingQASM(q)
+    qt.unitary(q, 0, u)
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    line = next(l for l in text.splitlines() if l.startswith("U("))
+    assert line.endswith(" q[0];")
+    params = [float(x) for x in line[2:line.index(")")].split(",")]
+    assert len(params) == 3
+    rebuilt = _zyz_matrix(*params)
+    # compare up to global phase
+    phase = u[0, 0] / rebuilt[0, 0]
+    assert abs(abs(phase) - 1) < 1e-6
+    assert np.allclose(rebuilt * phase, u, atol=1e-6)
+
+
+def test_compact_unitary_and_axis_rotation_zyz():
+    alpha, beta = 0.6 + 0.48j, 0.4 - 0.5j
+    norm = math.sqrt(abs(alpha) ** 2 + abs(beta) ** 2)
+    alpha, beta = alpha / norm, beta / norm
+    q = qt.createQureg(2, ENV)
+    qt.startRecordingQASM(q)
+    qt.compactUnitary(q, 0, alpha, beta)
+    qt.rotateAroundAxis(q, 1, 0.8, qt.Vector(1.0, 0.5, -0.25))
+    qt.stopRecordingQASM(q)
+    lines = [l for l in _recorded(q).splitlines() if l.startswith("U(")]
+    assert len(lines) == 2
+    # compactUnitary(alpha,beta) == [[a, -b*], [b, a*]]; ZYZ must rebuild it
+    params = [float(x) for x in lines[0][2:lines[0].index(")")].split(",")]
+    rebuilt = _zyz_matrix(*params)
+    target = np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
+    phase = target[0, 0] / rebuilt[0, 0]
+    assert np.allclose(rebuilt * phase, target, atol=1e-6)
+
+
+def test_controlled_unitary_phase_fix():
+    """Controlled unitaries get a trailing Rz restoring the global phase the
+    QASM U(a,b,c) form discards (qasm_recordControlledUnitary)."""
+    u = np.exp(0.3j) * np.array([[1, 0], [0, np.exp(0.7j)]])
+    q = qt.createQureg(2, ENV)
+    qt.startRecordingQASM(q)
+    qt.controlledUnitary(q, 0, 1, u)
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    assert "cU(" in text
+    assert "Restoring the discarded global phase" in text
+    # the fix is an uncontrolled Rz on the target
+    fix = [l for l in text.splitlines() if l.startswith("Rz(")]
+    assert len(fix) == 1 and fix[0].endswith(" q[1];")
+
+
+def test_controlled_phase_shift_phase_fix():
+    q = qt.createQureg(2, ENV)
+    qt.startRecordingQASM(q)
+    qt.controlledPhaseShift(q, 0, 1, 0.5)
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    assert "cRz(0.5) q[0],q[1];" in text
+    assert "Rz(0.25) q[1];" in text  # param/2 fix (QuEST_qasm.c:254-258)
+
+
+def test_multi_state_controlled_not_wrapping():
+    u = np.array([[0, 1], [1, 0]], dtype=complex)
+    q = qt.createQureg(3, ENV)
+    qt.startRecordingQASM(q)
+    qt.multiStateControlledUnitary(q, [0, 1], [0, 1], 2, u)
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    # control 0 is conditioned on |0>, so it is NOTed before and after
+    assert text.count("x q[0];") == 2
+    assert "ccU(" in text
+
+
+def test_multi_qubit_not_expansion():
+    q = qt.createQureg(3, ENV)
+    qt.startRecordingQASM(q)
+    qt.multiQubitNot(q, [0, 2])
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    assert "// The following 2 gates resulted from a single multiQubitNot() call" in text
+    assert "x q[0];" in text and "x q[2];" in text
+
+
+def test_init_records():
+    q = qt.createQureg(3, ENV)
+    qt.startRecordingQASM(q)
+    qt.initZeroState(q)
+    qt.initPlusState(q)
+    qt.initClassicalState(q, 5)
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    assert "reset q;" in text
+    assert "h q;" in text
+    assert "// Initialising state |5>" in text
+    # |5> = bits 0 and 2
+    assert "x q[0];" in text and "x q[2];" in text
 
 
 def test_not_recording_by_default_and_stop():
@@ -75,3 +222,53 @@ def test_measurement_recorded():
     qt.measure(q, 0)
     qt.stopRecordingQASM(q)
     assert "measure q[0] -> c[0];" in _recorded(q)
+
+
+def test_openqasm_line_grammar():
+    """Every recorded non-comment line must be parseable OPENQASM 2.0:
+    header, reg decls, gate lines `name(params)? q[i](,q[j])*;`, resets,
+    measures. The round-1 log emitted bare `U q[0];` (no params), which is
+    not valid QASM -- this guards the fix."""
+    import re
+
+    gate_re = re.compile(
+        r"^[a-zA-Z][a-zA-Z0-9]*(\([^()]*\))? q(\[\d+\])?(,q\[\d+\])*;$")
+    other_re = re.compile(
+        r"^(OPENQASM 2\.0;|qreg q\[\d+\];|creg c\[\d+\];|reset q;|"
+        r"measure q\[\d+\] -> c\[\d+\];)$")
+
+    rng = np.random.RandomState(3)
+    a = rng.randn(2, 2) + 1j * rng.randn(2, 2)
+    u, _ = np.linalg.qr(a)
+
+    q = qt.createQureg(4, ENV)
+    qt.startRecordingQASM(q)
+    qt.initZeroState(q)
+    qt.hadamard(q, 0)
+    qt.controlledNot(q, 0, 1)
+    qt.unitary(q, 2, u)
+    qt.controlledUnitary(q, 0, 2, u)
+    qt.compactUnitary(q, 3, 0.6, 0.8j)
+    qt.rotateAroundAxis(q, 1, 1.2, qt.Vector(0.0, 1.0, 0.0))
+    qt.controlledPhaseShift(q, 1, 2, 0.25)
+    qt.multiControlledPhaseShift(q, [0, 1, 2], 0.125)
+    qt.swapGate(q, 0, 3)
+    qt.measure(q, 0)
+    qt.stopRecordingQASM(q)
+    for line in _recorded(q).strip().splitlines():
+        if line.startswith("//"):
+            continue
+        assert gate_re.match(line) or other_re.match(line), line
+
+
+def test_param_format_matches_precision():
+    """REAL_QASM_FORMAT: %.8g in single, %.14g in double precision
+    (QuEST_precision.h:47,62)."""
+    log = qasm.QASMLogger(1, np.dtype("float32"))
+    log.start()
+    log.record_param_gate("rotateZ", 0, math.pi)
+    assert "Rz(3.1415927) q[0];" in log.printed()
+    log64 = qasm.QASMLogger(1, np.dtype("float64"))
+    log64.start()
+    log64.record_param_gate("rotateZ", 0, math.pi)
+    assert "Rz(3.1415926535898) q[0];" in log64.printed()
