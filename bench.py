@@ -94,6 +94,71 @@ def bench_density(n: int, reps: int, sync) -> dict:
     }
 
 
+def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
+    """One statevec config: random Clifford+T layers, two-frame fused."""
+    import time
+
+    import jax.numpy as jnp
+    from quest_tpu.ops import init as ops_init
+
+    circ = build_circuit(n, depth)
+    num_gates = len(circ)
+    fused = circ.fused(max_qubits=5, pallas=True)
+    print(f"# {n}q: fused {num_gates} gates -> {len(fused)} blocks",
+          file=sys.stderr)
+    if len(fused) > 48:
+        fn = fused.compiled_blocks(max_gates=24, donate=True)
+    else:
+        fn = fused.compiled(donate=True)
+
+    t0 = time.perf_counter()
+    amps = ops_init.init_classical(1 << n, jnp.dtype("float32"), 0)
+    amps = fn(amps)  # compile + warmup
+    sync(amps)
+    print(f"# {n}q compile+warmup {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        amps = fn(amps)
+    sync(amps)
+    dt = time.perf_counter() - t0
+    del amps
+
+    gates_per_sec = num_gates * reps / dt
+    ref = REF_GATES_PER_SEC.get(n)
+    return {
+        "metric": f"gate-ops/sec, {n}-qubit state-vector random Clifford+T",
+        "value": round(gates_per_sec, 2),
+        "unit": "gates/sec",
+        "vs_baseline": round(gates_per_sec / ref, 3) if ref else None,
+    }
+
+
+def plan_34q_distributed() -> dict:
+    """Config 5 (34q sharded state-vector) cannot run on one 16 GiB chip;
+    report the trace-time execution plan for the v5p-16 target instead
+    (the driver's virtual-mesh dryrun separately validates the sharded
+    path executes)."""
+    from quest_tpu import fusion
+    from quest_tpu.precision import real_dtype
+
+    n, depth = 34, 8
+    circ = build_circuit(n, depth)
+    p = fusion.plan(tuple(circ._tape), n, real_dtype(), max_qubits=5)
+    dense = sum(isinstance(i, fusion.FusedBlock) for i in p.items)
+    diag = sum(isinstance(i, fusion.DiagBlock) for i in p.items)
+    return {
+        "metric": "34q distributed plan: fused blocks for v5p-16 execution",
+        "value": len(p.items),
+        "unit": "blocks",
+        "vs_baseline": None,
+        "detail": {"gates": len(circ), "dense_blocks": dense,
+                   "diag_blocks": diag,
+                   "examples": "examples/distributed_34q.py"},
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--qubits", type=int, default=26)
@@ -101,10 +166,11 @@ def main() -> None:
     p.add_argument("--reps", type=int, default=5)
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for CI (12 qubits, depth 2)")
-    p.add_argument("--config", choices=["statevec", "density"],
-                   default="statevec",
-                   help="statevec: random Clifford+T (BASELINE configs 1-3); "
-                        "density: 14q decoherence channel (config 4)")
+    p.add_argument("--config",
+                   choices=["all", "statevec", "density"], default="all",
+                   help="all: every BASELINE.json milestone config (default);"
+                        " statevec: one random Clifford+T run at --qubits;"
+                        " density: the 14q decoherence channel")
     args = p.parse_args()
     if args.smoke:
         args.qubits, args.depth = 12, 2
@@ -118,9 +184,6 @@ def main() -> None:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    import jax.numpy as jnp
-    from quest_tpu.ops import init as ops_init
-
     def sync(a):
         # forces the whole donated chain to drain (see module docstring)
         return float(jax.device_get(a.reshape(-1)[0]))
@@ -129,47 +192,20 @@ def main() -> None:
         print(json.dumps(bench_density(14 if not args.smoke else 6,
                                        args.reps, sync)))
         return
+    if args.config == "statevec" or args.smoke:
+        print(json.dumps(bench_statevec(args.qubits, args.depth, args.reps,
+                                        sync)))
+        return
 
-    n, depth = args.qubits, args.depth
-    circ = build_circuit(n, depth)
-    num_gates = len(circ)
-    # Contract gate runs into contiguous-window unitaries at trace time
-    # (qsim-style dense fusion, quest_tpu/fusion.py): the device sees a
-    # handful of MXU GEMMs instead of hundreds of elementwise passes, and
-    # tile-local 1q/parity runs collapse further into single-HBM-pass Pallas
-    # kernels (ops/pallas_gates.py).
-    fused = circ.fused(max_qubits=5, pallas=True)
-    print(f"# fused {num_gates} gates -> {len(fused)} blocks", file=sys.stderr)
-    if len(fused) > 48:
-        fn = fused.compiled_blocks(max_gates=24, donate=True)
-    else:
-        fn = fused.compiled(donate=True)
-
-    t0 = time.perf_counter()
-    amps = ops_init.init_classical(1 << n, jnp.dtype("float32"), 0)
-    amps = fn(amps)  # compile + warmup
-    sync(amps)
-    print(f"# compile+warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
-
-    t0 = time.perf_counter()
-    for _ in range(args.reps):
-        amps = fn(amps)
-    sync(amps)
-    dt = time.perf_counter() - t0
-
-    gates_per_sec = num_gates * args.reps / dt
-    ref = REF_GATES_PER_SEC.get(n)
-    vs_baseline = round(gates_per_sec / ref, 3) if ref else None
-
-    dev = jax.devices()[0]
-    print(f"# {num_gates} gates x {args.reps} reps on {n}q in {dt:.3f}s "
-          f"on {dev.device_kind}", file=sys.stderr)
-    print(json.dumps({
-        "metric": f"gate-ops/sec, {n}-qubit state-vector random Clifford+T",
-        "value": round(gates_per_sec, 2),
-        "unit": "gates/sec",
-        "vs_baseline": vs_baseline,
-    }))
+    # all milestone configs (BASELINE.json "configs"); headline = 26q
+    configs = []
+    for n in (20, 24, 26):
+        configs.append(bench_statevec(n, args.depth, args.reps, sync))
+    configs.append(bench_density(14, args.reps, sync))
+    configs.append(plan_34q_distributed())
+    headline = dict(configs[2])
+    headline["configs"] = configs
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -293,49 +293,219 @@ class FusePlan:
 class PallasRun:
     """A run of tile-local 1-qubit matrices / parity phases executed in ONE
     Pallas HBM pass (ops.pallas_gates.fused_local_run). Gate targets must be
-    below ``tile_bits``; controls and parity members may be any qubit."""
+    below ``tile_bits``; controls and parity members may be any qubit.
+    Ops are in PHYSICAL coordinates (after any active FrameSwap)."""
     ops: tuple
     tile_bits: int
 
 
-def _pallas_op(ev: GateEvent, tile_bits: int):
-    """Lower a captured event to a pallas_gates op, or None if unsupported."""
-    from .ops.pallas_gates import HashableMatrix
-
-    if ev.kind == "parity":
-        return ("parity", ev.targets, ev.controls, float(ev.theta))
-    if len(ev.targets) != 1 or ev.targets[0] >= tile_bits:
-        return None
-    q = ev.targets[0]
-    states = tuple(ev.states) if ev.states else (1,) * len(ev.controls)
-    if ev.kind == "matrix":
-        m = ev.matrix
-    elif ev.kind == "diag":
-        m = np.diag(ev.diag)
-    elif ev.kind == "x":
-        m = np.array([[0, 1], [1, 0]], dtype=complex)
-    else:
-        return None
-    return ("matrix", q, tuple(ev.controls), states, HashableMatrix(m))
+@dataclass
+class FrameSwap:
+    """Exchange the top-k grid-bit block [tile_bits, tile_bits+k) with the
+    sublane block [tile_bits-k, tile_bits): one bandwidth-cost transpose
+    (ops.pallas_gates.swap_bit_blocks) that relabels high qubits tile-local
+    so the next PallasRun can target them. Self-inverse; the planner always
+    returns the register to the identity frame before any non-Pallas item."""
+    tile_bits: int
+    k: int
 
 
 def _window(qubits) -> tuple:
     return tuple(range(min(qubits), max(qubits) + 1))
 
 
+# ---------------------------------------------------------------------------
+# two-frame Pallas planning
+#
+# The fused Pallas kernel can target any qubit below tile_bits (in-tile) and
+# can use any qubit diagonally (controls, parity members, diagonal targets
+# -- grid bits enter as per-program scalars). The only thing it cannot do is
+# a dense target on a grid bit. The planner therefore runs the circuit in
+# two alternating qubit labelings ("frames"):
+#
+#   frame A: identity; in-tile logical qubits = [0, tile_bits)
+#   frame B: grid block [tile_bits, tile_bits+k) swapped with sublane block
+#            [tile_bits-k, tile_bits); in-tile = [0, tile_bits-k) and
+#            [tile_bits, tile_bits+k)
+#
+# with k = min(num grid bits, num sublane bits). Switching frames is ONE
+# bandwidth-cost transpose (swap_bit_blocks, ~ the elementwise floor), so a
+# deep circuit executes as [run_A][swap][run_B][swap][run_A]... -- every
+# gate rides a fused single-HBM-pass kernel and the whole layer costs ~2
+# kernel passes + ~2 transposes instead of one einsum block per high-qubit
+# window (the round-1 scheme: 60 blocks for a 26q depth-8 circuit; this
+# scheme: ~32 passes). This generalises the reference's swap-to-local trick
+# (QuEST_cpu_distributed.c:1526-1568) from one qubit per exchange to the
+# whole high block per transpose.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _POp:
+    """A primitive op in LOGICAL coordinates plus its diagonality roles."""
+    kind: str            # 'matrix' | 'swap' | 'diagw' | 'parity'
+    targets: tuple
+    controls: tuple
+    states: tuple
+    data: object         # matrix ndarray | diag ndarray | theta
+    diag_targets: bool   # True if the op acts diagonally on its targets
+
+    @property
+    def support(self):
+        return frozenset(self.targets) | frozenset(self.controls)
+
+    def diag_on(self, q: int) -> bool:
+        return q in self.controls or self.diag_targets
+
+
+def _lower_event(ev: GateEvent):
+    """GateEvent -> list of _POp, or None if not expressible as kernel ops
+    (dense multi-qubit matrices, wide diagonals)."""
+    states = tuple(ev.states) if ev.states else (1,) * len(ev.controls)
+    ctrls = tuple(ev.controls)
+    if ev.kind == "parity":
+        return [_POp("parity", tuple(ev.targets), ctrls, (), float(ev.theta), True)]
+    if ev.kind == "swap":
+        return [_POp("swap", tuple(ev.targets), ctrls, states, None, False)]
+    if ev.kind == "x":
+        # C[X (x) X ...] = product of single-target CXs (identical controls)
+        X = np.array([[0, 1], [1, 0]], dtype=complex)
+        return [_POp("matrix", (t,), ctrls, states, X, False)
+                for t in ev.targets]
+    if ev.kind == "diag":
+        if len(ev.targets) == 1:
+            return [_POp("matrix", tuple(ev.targets), ctrls, states,
+                         np.diag(ev.diag), True)]
+        if len(ev.targets) <= 5:
+            return [_POp("diagw", tuple(ev.targets), ctrls, (),
+                         np.asarray(ev.diag).reshape(-1), True)]
+        return None
+    if ev.kind == "matrix":
+        if len(ev.targets) != 1:
+            return None
+        m = np.asarray(ev.matrix)
+        is_diag = m[0, 1] == 0 and m[1, 0] == 0
+        return [_POp("matrix", tuple(ev.targets), ctrls, states, m, is_diag)]
+    return None  # pragma: no cover
+
+
+class _FramePlanner:
+    """Greedy two-frame scheduler: maintains the currently-open run and one
+    lookahead run in the other frame. Appending to the open run requires
+    commuting past every lookahead op (the open run executes first); when
+    neither run can take an op, the open run is emitted (with a frame swap
+    if needed) and the lookahead becomes open."""
+
+    def __init__(self, out: FusePlan, tile_bits: int, k: int):
+        self.out = out
+        self.tb = tile_bits
+        self.k = k
+        self.cur_frame = 0           # physical frame of the amps stream
+        self.open = (0, [])          # (frame, [_POp])
+        self.next = (1, [])
+
+    # -- frame geometry -----------------------------------------------------
+
+    def phys(self, q: int, frame: int) -> int:
+        if frame == 0 or self.k == 0:
+            return q
+        if self.tb - self.k <= q < self.tb:
+            return q + self.k
+        if self.tb <= q < self.tb + self.k:
+            return q - self.k
+        return q
+
+    def feasible(self, op: _POp, frame: int) -> bool:
+        if op.kind in ("parity", "diagw") or (op.kind == "matrix" and op.diag_targets):
+            return True
+        return all(self.phys(t, frame) < self.tb for t in op.targets)
+
+    def feasible_somewhere(self, op: _POp) -> bool:
+        return self.feasible(op, 0) or (self.k > 0 and self.feasible(op, 1))
+
+    # -- emission -----------------------------------------------------------
+
+    def _emit_run(self, frame: int, ops: list):
+        if not ops:
+            return
+        if self.cur_frame != frame and self.k > 0:
+            self.out.items.append(FrameSwap(self.tb, self.k))
+            self.cur_frame = frame
+        self.out.items.append(PallasRun(
+            tuple(self._phys_op(op, frame) for op in ops), self.tb))
+
+    def _phys_op(self, op: _POp, frame: int):
+        from .ops.pallas_gates import HashableMatrix
+
+        t = tuple(self.phys(q, frame) for q in op.targets)
+        c = tuple(self.phys(q, frame) for q in op.controls)
+        if op.kind == "matrix":
+            return ("matrix", t[0], c, op.states, HashableMatrix(op.data))
+        if op.kind == "swap":
+            return ("swap", t[0], t[1], c, op.states)
+        if op.kind == "diagw":
+            return ("diagw", t, c, HashableMatrix(op.data))
+        return ("parity", t, c, op.data)
+
+    def rotate(self):
+        frame, ops = self.open
+        self._emit_run(frame, ops)
+        self.open = self.next
+        self.next = (1 - self.open[0], [])
+
+    def flush(self):
+        """Emit both pending runs and return the amps to frame A."""
+        self._emit_run(*self.open)
+        self._emit_run(*self.next)
+        if self.cur_frame != 0 and self.k > 0:
+            self.out.items.append(FrameSwap(self.tb, self.k))
+            self.cur_frame = 0
+        self.open = (0, [])
+        self.next = (1, [])
+
+    # -- scheduling ---------------------------------------------------------
+
+    def add(self, op: _POp):
+        for _ in range(3):
+            of, oops = self.open
+            nf, nops = self.next
+            if self.feasible(op, of) and all(
+                    self._commutes(op, other) for other in nops):
+                oops.append(op)
+                return
+            if self.k > 0 and self.feasible(op, nf):
+                nops.append(op)
+                return
+            self.rotate()
+        raise AssertionError(  # pragma: no cover
+            "op feasible in no frame reached the scheduler")
+
+    @staticmethod
+    def _commutes(a: _POp, b: _POp) -> bool:
+        return all(a.diag_on(q) and b.diag_on(q)
+                   for q in a.support & b.support)
+
+
 def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
          max_diag_qubits: int = 12, pallas_tile_bits: int | None = None) -> FusePlan:
     """Greedy left-to-right fusion of a Circuit tape.
 
-    Dense events merge while the combined contiguous window spans at most
-    ``max_qubits``; diagonal events (phase gates, Z-rotations, parity
-    phases) merge by support up to ``max_diag_qubits`` regardless of span.
-    A tape entry that fails capture, or containing an event too wide for
-    either rule, flushes the current block and passes through unchanged.
+    Without ``pallas_tile_bits``: dense events merge while the combined
+    contiguous window spans at most ``max_qubits``; diagonal events (phase
+    gates, Z-rotations, parity phases) merge by support up to
+    ``max_diag_qubits`` regardless of span. A tape entry that fails capture,
+    or containing an event too wide for either rule, flushes the current
+    block and passes through unchanged.
+
+    With ``pallas_tile_bits``: two-frame Pallas planning (see the
+    _FramePlanner block comment) -- every expressible gate joins a fused
+    single-HBM-pass kernel run, with frame swaps localising high qubits;
+    only dense multi-qubit matrices fall out as window blocks.
     """
+    if pallas_tile_bits is not None:
+        return _plan_pallas(tape, num_qubits, dtype, max_qubits,
+                            pallas_tile_bits)
     out = FusePlan()
     cur = None  # None | FusedBlock | DiagBlock (mutable accumulators)
-    pal: list = []  # pending pallas ops (pallas_tile_bits mode only)
 
     def flush():
         nonlocal cur
@@ -343,23 +513,8 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
             out.items.append(cur)
         cur = None
 
-    def flush_pal():
-        if pal:
-            out.items.append(PallasRun(tuple(pal), pallas_tile_bits))
-            pal.clear()
-
     def window_ok(joint):
-        """Merge rule: within span, and (pallas mode) not straddling the
-        lane boundary -- straddling windows can't use the Pallas dot paths
-        (window_dot needs lo >= 7, lane_u needs hi < 7), so keeping windows
-        aligned preserves the fast dispatch for every block."""
-        if len(joint) > max_qubits:
-            return False
-        if pallas_tile_bits is not None:
-            from .ops.pallas_gates import LANE_BITS
-            if joint[0] < LANE_BITS <= joint[-1]:
-                return False
-        return True
+        return len(joint) <= max_qubits
 
     def add_dense(ev):
         nonlocal cur
@@ -408,27 +563,59 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
             else (len(_window(ev.support)) <= max_qubits)
             for ev in events)
         if not fusible:
-            flush_pal()
             flush()
             out.items.append((fn, args, kwargs))
             out.num_barriers += 1
             continue
         for ev in events:
-            if pallas_tile_bits is not None:
-                pop = _pallas_op(ev, pallas_tile_bits)
-                if pop is not None:
-                    flush()  # preserve order vs pending dense/diag work
-                    pal.append(pop)
-                    out.num_fused_gates += 1
-                    continue
-                flush_pal()
             if _event_is_diag(ev):
                 add_diag(ev)
             else:
                 add_dense(ev)
             out.num_fused_gates += 1
-    flush_pal()
     flush()
+    return out
+
+
+def _plan_pallas(tape, num_qubits: int, dtype, max_qubits: int,
+                 tile_bits: int) -> FusePlan:
+    """Two-frame Pallas plan: lower every event to kernel primitive ops and
+    schedule them across alternating qubit frames (see _FramePlanner)."""
+    from .ops.pallas_gates import LANE_BITS
+
+    out = FusePlan()
+    k = min(max(num_qubits - tile_bits, 0), tile_bits - LANE_BITS)
+    sched = _FramePlanner(out, tile_bits, k)
+
+    for fn, args, kwargs in tape:
+        events = capture(fn, args, kwargs, num_qubits, dtype)
+        lowered = None
+        if events is not None:
+            lowered = [_lower_event(ev) for ev in events]
+            ok = all(
+                (pops is not None
+                 and all(sched.feasible_somewhere(p) for p in pops))
+                or len(_window(ev.support)) <= max_qubits
+                for ev, pops in zip(events, lowered))
+            if not ok:
+                events = None  # too wide for any route: run the entry as-is
+        if events is None:
+            sched.flush()
+            out.items.append((fn, args, kwargs))
+            out.num_barriers += 1
+            continue
+        for ev, pops in zip(events, lowered):
+            if pops is not None and all(sched.feasible_somewhere(p) for p in pops):
+                for p in pops:
+                    sched.add(p)
+            else:
+                # dense multi-qubit matrix (or a target no frame localises):
+                # standalone window block through the engine, identity frame
+                sched.flush()
+                win = _window(ev.support)
+                out.items.append(FusedBlock(win, event_matrix(ev, win)))
+            out.num_fused_gates += 1
+    sched.flush()
     return out
 
 
@@ -454,8 +641,11 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int) -> None:
 
 def _apply_ops_via_engine(qureg, ops: tuple) -> None:
     """Replay pallas-format ops through the standard kernels (sharding-aware
-    via GSPMD or the explicit scheduler)."""
+    via GSPMD or the explicit scheduler). Ops are in physical coordinates;
+    the register's amps are in the same frame (FrameSwap tape entries apply
+    to every execution path), so direct replay is correct."""
     from . import gates as G
+    from .ops import apply as K
 
     for op in ops:
         if op[0] == "matrix":
@@ -464,6 +654,15 @@ def _apply_ops_via_engine(qureg, ops: tuple) -> None:
         elif op[0] == "parity":
             _, qubits, controls, theta = op
             G._apply_gate_parity_phase(qureg, theta, qubits, controls)
+        elif op[0] == "diagw":
+            _, targets, controls, d = op
+            G._apply_gate_diag(qureg, np.asarray(d.arr), targets, controls)
+        elif op[0] == "swap":
+            _, q1, q2, controls, states = op
+            if states and any(s == 0 for s in states):  # pragma: no cover
+                raise ValueError("swap with 0-controls has no engine route")
+            qureg.put(K.apply_swap(qureg.amps, n=qureg.num_qubits_in_state_vec,
+                                   qb1=q1, qb2=q2, controls=controls))
         else:  # pragma: no cover
             raise ValueError(f"unknown pallas op {op[0]!r}")
 
@@ -480,18 +679,23 @@ def _pallas_usable(qureg) -> bool:
 def _apply_dense_block(qureg, U: np.ndarray, qubits: tuple) -> None:
     """Dense window block dispatch: Pallas MXU dot paths when the register
     is single-device on TPU (window_dot for lo >= 7, a folded lane_u pass
-    for hi < 7 -- both ~3x faster per block than the XLA einsum), the
-    ordinary engine otherwise (CPU, sharded, straddling windows)."""
+    for hi < 7), the ordinary engine otherwise (CPU, sharded, windows the
+    dot kernels can't take).
+
+    Measured per-block at 2^26 amps f32, loop-inside-jit (tools/microbench):
+    elementwise floor 3.0 ms, lane_u pallas 4.0 ms, window_dot (5q, hi
+    qubits) 4.5 ms, XLA einsum same window 32 ms standalone -- yet routing
+    the hi-window blocks through window_dot made the *full* bench slightly
+    slower (694 vs 739 gates/s): inside one program XLA fuses the einsum
+    with neighbouring diagonal/elementwise work, while a pallas_call is an
+    opaque barrier. The einsum engine therefore keeps the hi windows; the
+    real win is eliminating standalone blocks entirely (two-frame Pallas
+    scheduling, see plan())."""
     from . import gates as G
     from .ops import pallas_gates as PG
 
     lo, hi = qubits[0], qubits[-1]
-    n = qureg.num_qubits_represented
     nsv = qureg.num_qubits_in_state_vec
-    # The measured per-block costs at 2^26 amps: lane_u pallas ~2.4 ms,
-    # einsum hi-window ~5-6 ms, einsum kron (lo<7) ~7.7 ms, window_dot
-    # ~5.6 ms flat. Only the lane route is a clear win; the einsum engine
-    # keeps the rest (window_dot stays available as PG.window_dot).
     if (_pallas_usable(qureg) and hi < PG.LANE_BITS
             and (1 << nsv) >= 2 * PG._LANES
             and not qureg.is_density_matrix):
@@ -506,6 +710,17 @@ def _apply_dense_block(qureg, U: np.ndarray, qubits: tuple) -> None:
     G._apply_gate_matrix(qureg, U, qubits)
 
 
+def _apply_frame_swap(qureg, tile_bits: int, k: int) -> None:
+    """Tape-entry wrapper for FrameSwap: one relabeling transpose. Works on
+    every backend (plain XLA); on a sharded register GSPMD lowers it to the
+    all-to-all the relabeling implies."""
+    from .ops.pallas_gates import swap_bit_blocks
+
+    assert not qureg.is_density_matrix
+    qureg.put(swap_bit_blocks(qureg.amps, n=qureg.num_qubits_in_state_vec,
+                              lo1=tile_bits - k, lo2=tile_bits, k=k))
+
+
 def as_tape(p: FusePlan) -> list:
     """Lower a FusePlan back to Circuit tape entries (fn, args, kwargs)."""
     from . import gates as G
@@ -518,6 +733,8 @@ def as_tape(p: FusePlan) -> list:
             entries.append((_apply_dense_block, (item.matrix, item.qubits), {}))
         elif isinstance(item, PallasRun):
             entries.append((_apply_pallas_run, (item.ops, item.tile_bits), {}))
+        elif isinstance(item, FrameSwap):
+            entries.append((_apply_frame_swap, (item.tile_bits, item.k), {}))
         else:
             entries.append(item)
     return entries

@@ -26,8 +26,16 @@ amplitude i with i ^ 2^q:
 
 Ops format (all matrix data static at trace time, baked into the kernel):
 
-    ("matrix", q, controls, states, M)   M: 2x2 complex ndarray, q local
+    ("matrix", q, controls, states, M)   M: 2x2 complex ndarray; q local,
+                                         OR any qubit if M is diagonal
+                                         (grid-bit diagonals need only a
+                                         per-program scalar select)
     ("parity", qubits, controls, theta)  exp(-i theta/2 Z...Z), any qubits
+    ("swap", q1, q2, controls, states)   SWAP(q1, q2); both targets local
+    ("diagw", targets, controls, D)      D: (2^t,) complex diagonal over
+                                         ``targets`` (any qubits; grid
+                                         members enter the table index as
+                                         per-program scalars)
     ("lane_u", W)                        W: 256x256 real block matrix from
                                          _fold_lane_ops -- a whole run of
                                          lane-qubit gates as ONE MXU dot
@@ -115,6 +123,12 @@ def _lane_foldable(op) -> bool:
     if op[0] == "parity":
         return (all(q < LANE_BITS for q in op[1])
                 and all(c < LANE_BITS for c in op[2]))
+    if op[0] == "swap":
+        return (op[1] < LANE_BITS and op[2] < LANE_BITS
+                and all(c < LANE_BITS for c in op[3]))
+    if op[0] == "diagw":
+        return (all(q < LANE_BITS for q in op[1])
+                and all(c < LANE_BITS for c in op[2]))
     return False
 
 
@@ -140,6 +154,13 @@ def _fold_lane_ops(ops) -> tuple:
                 ev = GateEvent("matrix", (op[1],), tuple(op[2]), tuple(op[3]),
                                matrix=np.asarray(op[4].arr if hasattr(op[4], "arr")
                                                  else op[4]))
+            elif op[0] == "swap":
+                ev = GateEvent("swap", (op[1], op[2]), tuple(op[3]),
+                               tuple(op[4]))
+            elif op[0] == "diagw":
+                ev = GateEvent("diag", tuple(op[1]), tuple(op[2]),
+                               diag=np.asarray(op[3].arr if hasattr(op[3], "arr")
+                                               else op[3]).reshape(-1))
             else:
                 ev = GateEvent("parity", tuple(op[1]), tuple(op[2]),
                                theta=float(op[3]))
@@ -198,10 +219,12 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                 _, q, controls, states, M = op
                 m00, m01, m10, m11 = (complex(M[0, 0]), complex(M[0, 1]),
                                       complex(M[1, 0]), complex(M[1, 1]))
-                bit = _bit_mask(q, shape)
 
                 if m01 == 0 and m10 == 0:
-                    # diagonal 2x2: no partner exchange at all
+                    # diagonal 2x2: no partner exchange at all; the target
+                    # may even be a grid bit (per-program scalar select)
+                    bit = (_grid_bit(q, tile_bits) if q >= tile_bits
+                           else _bit_mask(q, shape))
                     dr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
                     di = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
                     keep = _keep_factor(controls, states, tile_bits, shape, dtype)
@@ -210,6 +233,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                         di = keep * di
                     xr, xi = (dr * xr - di * xi, dr * xi + di * xr)
                     continue
+                bit = _bit_mask(q, shape)
 
                 pr = _partner(xr, q)
                 pi = _partner(xi, q)
@@ -265,6 +289,40 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                     fi = keep * fi
                 xr, xi = (xr * fr - xi * fi, xr * fi + xi * fr)
 
+            elif op[0] == "swap":
+                _, q1, q2, controls, states = op
+                # amps where bits q1,q2 differ exchange with partner(^q1^q2)
+                p2r = _partner(_partner(xr, q1), q2)
+                p2i = _partner(_partner(xi, q1), q2)
+                differ = (_bit_mask(q1, shape) ^ _bit_mask(q2, shape)).astype(dtype)
+                keep = _keep_factor(controls, states, tile_bits, shape, dtype)
+                sel = differ if keep is None else differ * keep
+                xr = xr + sel * (p2r - xr)
+                xi = xi + sel * (p2i - xi)
+
+            elif op[0] == "diagw":
+                _, targets, controls, D = op
+                d = np.asarray(D.arr if hasattr(D, "arr") else D).reshape(-1)
+                # table index: in-tile target bits come from iota masks,
+                # grid-bit targets from per-program scalars (broadcasts)
+                idx = None
+                for j, q in enumerate(targets):
+                    b = (_grid_bit(q, tile_bits) if q >= tile_bits
+                         else _bit_mask(q, shape))
+                    term = b << j
+                    idx = term if idx is None else idx + term
+                fr = jnp.full(shape, dtype.type(d[0].real))
+                fi = jnp.full(shape, dtype.type(d[0].imag))
+                for k in range(1, d.size):
+                    hit = idx == k
+                    fr = jnp.where(hit, dtype.type(d[k].real), fr)
+                    fi = jnp.where(hit, dtype.type(d[k].imag), fi)
+                keep = _keep_factor(controls, (), tile_bits, shape, dtype)
+                if keep is not None:
+                    fr = one + keep * (fr - one)
+                    fi = keep * fi
+                xr, xi = (xr * fr - xi * fi, xr * fi + xi * fr)
+
             else:  # pragma: no cover
                 raise ValueError(f"unknown pallas op {op[0]!r}")
 
@@ -287,10 +345,19 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
         raise ValueError(
             f"state has {amps.shape[-1]} amplitudes < one {_LANES}-lane tile; "
             f"registers below {LANE_BITS + 1} qubits take the ordinary path")
-    if any(o[0] == "matrix" and o[1] >= local_qubits(n, sublanes) for o in ops):
-        raise ValueError(
-            f"matrix target >= local_qubits({n}, {sublanes}) = "
-            f"{local_qubits(n, sublanes)}; route wide targets via ops.apply")
+
+    def _is_diag_matrix(o):
+        m = o[4].arr if hasattr(o[4], "arr") else o[4]
+        return complex(m[0][1]) == 0 and complex(m[1][0]) == 0
+
+    lq = local_qubits(n, sublanes)
+    for o in ops:
+        if o[0] == "matrix" and o[1] >= lq and not _is_diag_matrix(o):
+            raise ValueError(
+                f"non-diagonal matrix target {o[1]} >= local_qubits({n}, "
+                f"{sublanes}) = {lq}; route wide targets via ops.apply")
+        if o[0] == "swap" and (o[1] >= lq or o[2] >= lq):
+            raise ValueError(f"swap targets {o[1:3]} must be < {lq}")
     return _fused_local_run(amps, n=n, ops=_fold_lane_ops(ops),
                             sublanes=sublanes, interpret=bool(interpret))
 
@@ -317,6 +384,9 @@ def _fused_local_run(amps, *, n: int, ops: tuple, sublanes: int,
         elif o[0] == "matrix":
             ops_r.append((o[0], o[1], o[2], o[3],
                           np.asarray(o[4].arr if hasattr(o[4], "arr") else o[4])))
+        elif o[0] == "diagw":
+            ops_r.append((o[0], o[1], o[2],
+                          np.asarray(o[3].arr if hasattr(o[3], "arr") else o[3])))
         else:
             ops_r.append(o)
     kernel = _make_kernel(tuple(ops_r), s_bits, tile_bits, np.dtype(amps.dtype))
@@ -333,6 +403,10 @@ def _fused_local_run(amps, *, n: int, ops: tuple, sublanes: int,
                                memory_space=pltpu.VMEM)] * len(ws),
         out_specs=pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
                                memory_space=pltpu.VMEM),
+        # long fused runs accumulate per-gate temporaries past the default
+        # 16 MiB scoped-VMEM budget; the physical VMEM is far larger
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(x, *ws)
     return out.reshape(2, -1)
@@ -415,6 +489,27 @@ def _window_dot(amps, matrix, *, n: int, lo: int, hi: int, conj: bool,
         interpret=interpret,
     )(x, w4)
     return out.reshape(2, -1)
+
+
+@partial(jax.jit, static_argnames=("n", "lo1", "lo2", "k"), donate_argnums=(0,))
+def swap_bit_blocks(amps, *, n: int, lo1: int, lo2: int, k: int):
+    """Exchange the k-bit index blocks [lo1, lo1+k) and [lo2, lo2+k)
+    (lo1 + k <= lo2) of the planar (2, 2^n) state: a pure qubit relabeling
+    executed as one XLA transpose. Measured at the elementwise floor
+    (2.8 ms at 2^26 f32, tools/microbench) -- switching the two-frame
+    execution scheme's frame costs one bandwidth pass.
+
+    This is the single-chip analogue of the reference's swap-to-local
+    relocation (QuEST_cpu_distributed.c:1526-1568): instead of moving one
+    distributed qubit at a time through pair exchanges, the whole grid-bit
+    block swaps with an equal sublane block so gates on high qubits become
+    tile-local for the fused Pallas kernel."""
+    assert lo1 + k <= lo2 and lo2 + k <= n
+    d = 1 << k
+    low = 1 << lo1
+    mid = 1 << (lo2 - lo1 - k)
+    x = amps.reshape(2, -1, d, mid, d, low)
+    return x.transpose(0, 1, 4, 3, 2, 5).reshape(2, -1)
 
 
 class HashableMatrix:
