@@ -55,19 +55,25 @@ static void ensureInit(void) {
         char *slash = strrchr(libdir, '/');
         if (slash) *slash = '\0';
     }
-    char bootstrap[8192];
-    snprintf(bootstrap, sizeof bootstrap,
+    /* pass the library directory out-of-band as a sys attribute: splicing
+     * it into a Python string literal breaks on quotes/backslashes, and
+     * setenv() is invisible to os.environ if the embedding host imported
+     * os before calling us */
+    {
+        PyObject *dir = PyUnicode_FromString(libdir);
+        if (dir) { PySys_SetObject("_quest_tpu_libdir", dir); Py_DECREF(dir); }
+    }
+    const char *bootstrap =
         "import sys, os\n"
         "for _p in (os.environ.get('QUEST_TPU_PYTHONPATH') or '').split(':')[::-1]:\n"
         "    if _p and _p not in sys.path: sys.path.insert(0, _p)\n"
         "if os.getcwd() not in sys.path: sys.path.insert(0, os.getcwd())\n"
-        "_d = %s%s%s\n"
+        "_d = getattr(sys, '_quest_tpu_libdir', '')\n"
         "while _d and _d != os.path.dirname(_d):\n"
         "    if os.path.isdir(os.path.join(_d, 'quest_tpu')):\n"
         "        if _d not in sys.path: sys.path.insert(0, _d)\n"
         "        break\n"
-        "    _d = os.path.dirname(_d)\n",
-        libdir[0] ? "r'" : "''", libdir[0] ? libdir : "", libdir[0] ? "'" : "");
+        "    _d = os.path.dirname(_d)\n";
     PyRun_SimpleString(bootstrap);
     gBridge = PyImport_ImportModule("quest_tpu.capi_bridge");
     if (!gBridge) fatalPy("import quest_tpu.capi_bridge");

@@ -163,15 +163,29 @@ def _pauli_prod_amps(amps, term, nsv, dt):
     return amps
 
 
+#: terms per compiled block in _expec_pauli_sum_fused: each term unrolls an
+#: O(n)-op Pauli pipeline into the program, so program size (and compile
+#: time) grows linearly with terms -- the same compile-limit failure mode
+#: Circuit.blocks() bounds. 64 terms x ~n ops stays well under XLA limits.
+_EXPEC_TERM_BLOCK = 64
+
+
 def _expec_pauli_sum_fused(amps, coeffs, *, codes, n, density):
-    """sum_t c_t <P_t>, the whole sum as ONE XLA program.
+    """sum_t c_t <P_t>, fused into one XLA program per 64-term block.
 
     The reference pays a full state clone, O(n) kernel launches, and an
     Allreduce per term (QuEST_common.c:505-532); here the term loop unrolls
     at trace time so XLA schedules every term's Pauli pipeline and reduction
-    inside a single dispatch (SURVEY.md section 3.5's noted fusion win)."""
-    return _expec_pauli_sum_run(amps, coeffs, codes=codes, n=n,
-                                density=density)
+    inside a single dispatch (SURVEY.md section 3.5's noted fusion win).
+    Hamiltonians beyond _EXPEC_TERM_BLOCK terms chain a few block-sized
+    executables instead of growing one unbounded program."""
+    total = 0.0
+    for i in range(0, len(codes), _EXPEC_TERM_BLOCK):
+        block = codes[i:i + _EXPEC_TERM_BLOCK]
+        total = total + _expec_pauli_sum_run(
+            amps, coeffs[i:i + _EXPEC_TERM_BLOCK], codes=block, n=n,
+            density=density)
+    return total
 
 
 def _make_expec_pauli_sum_run():

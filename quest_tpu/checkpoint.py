@@ -42,11 +42,26 @@ def saveQureg(qureg: Qureg, directory: str) -> None:
     any existing metadata is invalidated first, the amplitude payload is
     written via rename, and fresh metadata is written (also via rename)
     only after the payload is on disk."""
+    amps = qureg.amps
+    if not amps.is_fully_addressable:
+        # multi-host (jax.distributed) global array: gather every shard to
+        # every process first -- np.asarray on a non-addressable array
+        # raises. The gather is a collective, so EVERY process must reach
+        # it before any rank-dependent branch; afterwards only process 0
+        # touches the filesystem, so pod-wide saves into one shared
+        # directory don't race on the unlink/rename.
+        from jax.experimental import multihost_utils
+
+        host = np.asarray(multihost_utils.process_allgather(
+            amps, tiled=True))
+        if jax.process_index() != 0:
+            return
+    else:
+        host = np.asarray(amps)  # device -> host, any single-host sharding
     os.makedirs(directory, exist_ok=True)
     meta_path = os.path.join(directory, _META_NAME)
     if os.path.exists(meta_path):
         os.unlink(meta_path)  # a crash mid-overwrite must not look loadable
-    host = np.asarray(qureg.amps)  # device -> host, any sharding
     amps_tmp = os.path.join(directory, _AMPS_NAME + ".tmp")
     with open(amps_tmp, "wb") as f:
         np.savez_compressed(f, amps=host)
@@ -99,8 +114,12 @@ def loadQureg(directory: str, env: QuESTEnv) -> Qureg:
     arr = jax.device_put(host.astype(meta["dtype"]), sharding)
     qureg.put(arr)
 
-    env.seeds = list(meta.get("seeds", []))
-    _restore_rng(env, meta.get("rng_state"))
+    # only restore the seed/RNG pair when the snapshot actually carries one
+    # (a register saved with env=None must not clobber the live env's seeds
+    # while leaving its RNG stream untouched)
+    if meta.get("rng_state") is not None:
+        env.seeds = list(meta.get("seeds", []))
+        _restore_rng(env, meta["rng_state"])
     return qureg
 
 

@@ -68,10 +68,15 @@ class DistributedScheduler:
         support = set(targets) | set(controls)
         free = [q for q in range(nl) if q not in support]
         if len(free) < len(shard_ts):
-            raise ValueError(
-                f"gate on {len(targets)} targets needs {len(shard_ts)} free "
-                f"local qubits but only {len(free)} exist (chunk too small, "
-                f"as the reference's matrix-fits-in-node validation)")
+            # surface through the overridable error hook, as the reference's
+            # matrix-fits-in-node check (validateMultiQubitMatrixFitsInNode,
+            # QuEST_validation.c:522-524, E_CANNOT_FIT_MULTI_QUBIT_MATRIX)
+            from .. import validation as V
+            V._assert(False,
+                      "The specified matrix targets too many qubits; the "
+                      "batches of amplitudes to modify cannot all fit in a "
+                      "single distributed node's memory allocation.",
+                      "applyMatrix")
         relocation = dict(zip(shard_ts, free))
         for s, f in relocation.items():
             amps = self.apply_swap(amps, n=n, qb1=f, qb2=s)
