@@ -74,13 +74,36 @@ def apply_channel(amps, superop, *, n: int, targets: tuple[int, ...]):
     Large registers use the Kraus-sum formulation: rho' = sum_i s_i K_i rho
     K_i^dagger, each term two layout-clean single-group passes (row bits,
     then conjugated column bits) -- the TPU equivalent of the reference's
-    pair-exchange channel protocol (QuEST_cpu_distributed.c:724-868)."""
+    pair-exchange channel protocol (QuEST_cpu_distributed.c:724-868).
+
+    Under an explicit_mesh context, every dense application routes through
+    the distributed scheduler, so channels on sharded qubits take the same
+    relocation-planner path as gates (the analogue of the reference's
+    half-chunk depolarising/damping exchanges,
+    QuEST_cpu_distributed.c:535-868) and show up in the plan stats."""
+    from ..parallel import scheduler as _dist
+
+    sched = _dist.active()
+    if sched is not None:
+        sched.stats["channel_superops"] += 1
     if 2 * n <= _SUPEROP_MAX_QUBITS:
         ext_targets = tuple(targets) + tuple(q + n for q in targets)
         so = cplx.from_complex(superop, amps.dtype)
+        if sched is not None:
+            return sched.apply_matrix(amps, so, n=2 * n, targets=ext_targets)
         return apply.apply_matrix(amps, so, n=2 * n, targets=ext_targets)
 
     terms = choi_kraus(superop)
+    if sched is not None:
+        shifted = tuple(q + n for q in targets)
+        out = None
+        for sign, k in terms:
+            km = jnp.asarray(np.stack([k.real, k.imag]), dtype=amps.dtype)
+            t = sched.apply_matrix(amps + 0, km, n=2 * n, targets=tuple(targets))
+            t = sched.apply_matrix(t, km, n=2 * n, targets=shifted, conj=True)
+            t = sign * t if sign != 1.0 else t
+            out = t if out is None else out + t
+        return out
     signs = tuple(s for s, _ in terms)
     ks = np.stack([np.stack([k.real, k.imag]) for _, k in terms])
     return _apply_kraus_sum(amps, jnp.asarray(ks, dtype=amps.dtype),
@@ -123,14 +146,25 @@ def dephase_factors_2q(prob: float) -> np.ndarray:
     return d
 
 
+def _diag_dispatch(amps, d, *, n, targets):
+    """Dephasing diagonals via the explicit scheduler when one is active
+    (comm-free by construction, counted in its plan stats)."""
+    from ..parallel import scheduler as _dist
+
+    sched = _dist.active()
+    if sched is not None:
+        return sched.apply_diagonal(amps, d, n=n, targets=targets)
+    return diagonal.apply_diagonal(amps, d, n=n, targets=targets)
+
+
 def apply_dephasing(amps, prob, *, n: int, target: int):
     d = cplx.from_complex(dephase_factors_1q(prob), amps.dtype)
-    return diagonal.apply_diagonal(amps, d, n=2 * n, targets=(target, target + n))
+    return _diag_dispatch(amps, d, n=2 * n, targets=(target, target + n))
 
 
 def apply_two_qubit_dephasing(amps, prob, *, n: int, q1: int, q2: int):
     d = cplx.from_complex(dephase_factors_2q(prob), amps.dtype)
-    return diagonal.apply_diagonal(amps, d, n=2 * n, targets=(q1, q2, q1 + n, q2 + n))
+    return _diag_dispatch(amps, d, n=2 * n, targets=(q1, q2, q1 + n, q2 + n))
 
 
 def depolarising_kraus(prob: float):

