@@ -161,7 +161,7 @@ class Circuit:
         return self._compiled[key]
 
     def fused(self, max_qubits: int = 5, dtype=None,
-              pallas: bool = False) -> "Circuit":
+              pallas: bool = False, shard_devices: int | None = None) -> "Circuit":
         """A new Circuit with runs of gates contracted into ``max_qubits``-
         qubit unitaries at trace time (see :mod:`quest_tpu.fusion`).
 
@@ -169,10 +169,13 @@ class Circuit:
         captured as gate primitives (decoherence, phase functions, inits)
         pass through unchanged and act as fusion barriers.
 
-        ``pallas=True`` (state-vector tapes only) additionally routes runs
-        of tile-local 1-qubit gates and parity phases through the fused
-        Pallas kernel (ops.pallas_gates): one HBM pass per run instead of
-        one GEMM pass per dense block.
+        ``pallas=True`` (state-vector tapes only) additionally routes gate
+        runs through the fused Pallas kernel (ops.pallas_gates) with
+        two-frame scheduling: one HBM pass per run instead of one GEMM pass
+        per dense block. ``shard_devices`` plans for execution on a register
+        sharded over that many devices: the tile limit shrinks to the
+        shard-local size so every emitted run is per-shard executable under
+        shard_map (fusion._shard_map_pallas_run).
         """
         import numpy as np
 
@@ -182,10 +185,18 @@ class Circuit:
         tile_bits = None
         if pallas and not self.is_density_matrix:
             from .ops.pallas_gates import LANE_BITS, local_qubits
+            n_eff = self.num_qubits
+            if shard_devices and shard_devices > 1:
+                d = int(shard_devices)
+                if d & (d - 1):
+                    raise ValueError(
+                        f"shard_devices must be a power of 2 (got {d}); "
+                        "amplitude sharding splits whole top qubits")
+                n_eff -= d.bit_length() - 1
             # below 2^LANE_BITS amplitudes there is no lane tile to build;
             # the ordinary fusion path handles such registers
-            if self.num_qubits > LANE_BITS:
-                tile_bits = local_qubits(self.num_qubits)
+            if n_eff > LANE_BITS:
+                tile_bits = local_qubits(n_eff)
         p = fusion.plan(tuple(self._tape), self.num_qubits,
                         np.dtype(dtype) if dtype else real_dtype(),
                         max_qubits=max_qubits, pallas_tile_bits=tile_bits)

@@ -99,14 +99,16 @@ def _partner(arr, q: int):
     return jnp.where(bit == 0, up, dn)
 
 
-def _ctrl_scalar_and_mask(controls, states, tile_bits, shape):
-    """(static_ok, elementwise {0,1} mask or None) for a control set."""
+def _ctrl_scalar_and_mask(controls, states, tile_bits, shape, gbit):
+    """(per-program scalar {0,1} or None, elementwise {0,1} mask or None)
+    for a control set; ``gbit(q)`` resolves bits above the tile (grid bits
+    from pl.program_id, shard bits from the SMEM shard-index scalar)."""
     states = states if states else (1,) * len(controls)
     mask = None
     scalar = None
     for c, st in zip(controls, states):
         if c >= tile_bits:
-            b = _grid_bit(c, tile_bits)
+            b = gbit(c)
             ok = jnp.where(b == st, 1, 0)
             scalar = ok if scalar is None else scalar * ok
         else:
@@ -180,10 +182,10 @@ def _fold_lane_ops(ops) -> tuple:
     return tuple(out)
 
 
-def _keep_factor(controls, states, tile_bits, shape, dtype):
+def _keep_factor(controls, states, tile_bits, shape, dtype, gbit):
     """{0,1} dtype factor that is 1 exactly where the control pattern is
     satisfied (combining grid-bit scalars and in-tile masks), or None."""
-    scalar, mask = _ctrl_scalar_and_mask(controls, states, tile_bits, shape)
+    scalar, mask = _ctrl_scalar_and_mask(controls, states, tile_bits, shape, gbit)
     if scalar is not None and mask is not None:
         return (scalar * mask).astype(dtype)
     if scalar is not None:
@@ -193,18 +195,30 @@ def _keep_factor(controls, states, tile_bits, shape, dtype):
     return None
 
 
-def _make_kernel(ops, s_bits, tile_bits, dtype):
-    """Kernel over (x_ref, *w_refs, o_ref); ops of kind 'lane_u' carry an
-    index into w_refs (their 256x256 block matrices arrive as operands --
-    Pallas kernels may not capture array constants)."""
+def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None):
+    """Kernel over (x_ref, hi_ref, *w_refs, o_ref); ops of kind 'lane_u'
+    carry an index into w_refs (their 256x256 block matrices arrive as
+    operands -- Pallas kernels may not capture array constants).
+
+    ``hi_ref`` is an SMEM scalar holding the shard index when the kernel
+    runs per-device inside shard_map (``local_n`` = the shard's qubit
+    count): qubit roles at q >= local_n resolve against it, so controls,
+    parity members and diagonal targets on SHARDED qubits work in-kernel
+    with zero communication -- the Pallas analogue of the scheduler's
+    rank-bit controls (parallel/exchange.py)."""
     one = np.array(1, dtype)
 
-    def kernel(x_ref, *refs):
+    def kernel(x_ref, hi_ref, *refs):
         w_refs = refs[:-1]
         o_ref = refs[-1]
         xr = x_ref[0]
         xi = x_ref[1]
         shape = xr.shape
+
+        def gbit(q):
+            if local_n is not None and q >= local_n:
+                return (hi_ref[0] >> (q - local_n)) & 1
+            return _grid_bit(q, tile_bits)
 
         for op in ops:
             if op[0] == "lane_u":
@@ -223,11 +237,10 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                 if m01 == 0 and m10 == 0:
                     # diagonal 2x2: no partner exchange at all; the target
                     # may even be a grid bit (per-program scalar select)
-                    bit = (_grid_bit(q, tile_bits) if q >= tile_bits
-                           else _bit_mask(q, shape))
+                    bit = gbit(q) if q >= tile_bits else _bit_mask(q, shape)
                     dr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
                     di = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
-                    keep = _keep_factor(controls, states, tile_bits, shape, dtype)
+                    keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
                     if keep is not None:
                         dr = one + keep * (dr - one)
                         di = keep * di
@@ -243,7 +256,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                     # real matrix (H, X, Ry...): half the arithmetic
                     csr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
                     cpr = jnp.where(bit == 0, dtype.type(m01.real), dtype.type(m10.real))
-                    keep = _keep_factor(controls, states, tile_bits, shape, dtype)
+                    keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
                     if keep is not None:
                         csr = one + keep * (csr - one)
                         cpr = keep * cpr
@@ -256,7 +269,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                 cpi = jnp.where(bit == 0, dtype.type(m01.imag), dtype.type(m10.imag))
                 # fold controls into the coefficients (identity where the
                 # control pattern misses) -- cheaper than output blending
-                keep = _keep_factor(controls, states, tile_bits, shape, dtype)
+                keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
                 if keep is not None:
                     csr = one + keep * (csr - one)
                     csi = keep * csi
@@ -271,7 +284,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                 par = None
                 for q in qubits:
                     if q >= tile_bits:
-                        gb = _grid_bit(q, tile_bits)
+                        gb = gbit(q)
                         sign_scalar = sign_scalar * (1 - 2 * gb)
                     else:
                         b = _bit_mask(q, shape)
@@ -283,7 +296,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                 s = dtype.type(math.sin(theta / 2))
                 fr = c * jnp.ones_like(sign)
                 fi = -s * sign
-                keep = _keep_factor(controls, (), tile_bits, shape, dtype)
+                keep = _keep_factor(controls, (), tile_bits, shape, dtype, gbit)
                 if keep is not None:
                     fr = one + keep * (fr - one)
                     fi = keep * fi
@@ -295,7 +308,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                 p2r = _partner(_partner(xr, q1), q2)
                 p2i = _partner(_partner(xi, q1), q2)
                 differ = (_bit_mask(q1, shape) ^ _bit_mask(q2, shape)).astype(dtype)
-                keep = _keep_factor(controls, states, tile_bits, shape, dtype)
+                keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
                 sel = differ if keep is None else differ * keep
                 xr = xr + sel * (p2r - xr)
                 xi = xi + sel * (p2i - xi)
@@ -307,8 +320,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                 # grid-bit targets from per-program scalars (broadcasts)
                 idx = None
                 for j, q in enumerate(targets):
-                    b = (_grid_bit(q, tile_bits) if q >= tile_bits
-                         else _bit_mask(q, shape))
+                    b = gbit(q) if q >= tile_bits else _bit_mask(q, shape)
                     term = b << j
                     idx = term if idx is None else idx + term
                 fr = jnp.full(shape, dtype.type(d[0].real))
@@ -317,7 +329,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
                     hit = idx == k
                     fr = jnp.where(hit, dtype.type(d[k].real), fr)
                     fi = jnp.where(hit, dtype.type(d[k].imag), fi)
-                keep = _keep_factor(controls, (), tile_bits, shape, dtype)
+                keep = _keep_factor(controls, (), tile_bits, shape, dtype, gbit)
                 if keep is not None:
                     fr = one + keep * (fr - one)
                     fi = keep * fi
@@ -333,12 +345,17 @@ def _make_kernel(ops, s_bits, tile_bits, dtype):
 
 
 def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None, shard_index=None):
     """Apply ``ops`` (see module doc) to the planar (2, 2^n) state in one
     fused Pallas pass. Every matrix target must satisfy
     ``q < local_qubits(n, sublanes)``; parity members and controls may be
     any qubit. ``ops`` is hashable (tuples + HashableMatrix wrappers).
-    On non-TPU backends the kernel runs in the Pallas interpreter (CI)."""
+    On non-TPU backends the kernel runs in the Pallas interpreter (CI).
+
+    ``shard_index`` (traced i32 scalar, e.g. ``jax.lax.axis_index`` inside
+    shard_map) enables per-shard execution: ``amps`` is then one device's
+    shard with ``n`` LOCAL qubits, and op roles on qubits >= n (sharded
+    qubits of the global register) resolve against the shard index."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if amps.shape[-1] < _LANES:
@@ -358,14 +375,22 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
                 f"{sublanes}) = {lq}; route wide targets via ops.apply")
         if o[0] == "swap" and (o[1] >= lq or o[2] >= lq):
             raise ValueError(f"swap targets {o[1:3]} must be < {lq}")
-    return _fused_local_run(amps, n=n, ops=_fold_lane_ops(ops),
-                            sublanes=sublanes, interpret=bool(interpret))
+    if shard_index is None:
+        shard_index = jnp.zeros((1,), jnp.int32)
+        local_n = None
+    else:
+        shard_index = jnp.asarray(shard_index, jnp.int32).reshape(1)
+        local_n = n
+    return _fused_local_run(amps, shard_index, n=n, ops=_fold_lane_ops(ops),
+                            sublanes=sublanes, interpret=bool(interpret),
+                            local_n=local_n)
 
 
-@partial(jax.jit, static_argnames=("n", "ops", "sublanes", "interpret"),
+@partial(jax.jit, static_argnames=("n", "ops", "sublanes", "interpret",
+                                  "local_n"),
          donate_argnums=(0,))
-def _fused_local_run(amps, *, n: int, ops: tuple, sublanes: int,
-                     interpret: bool):
+def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
+                     interpret: bool, local_n: int | None):
     num = amps.shape[-1]
     rows = max(num >> LANE_BITS, 1)
     s = min(sublanes, rows)
@@ -389,7 +414,8 @@ def _fused_local_run(amps, *, n: int, ops: tuple, sublanes: int,
                           np.asarray(o[3].arr if hasattr(o[3], "arr") else o[3])))
         else:
             ops_r.append(o)
-    kernel = _make_kernel(tuple(ops_r), s_bits, tile_bits, np.dtype(amps.dtype))
+    kernel = _make_kernel(tuple(ops_r), s_bits, tile_bits, np.dtype(amps.dtype),
+                          local_n=local_n)
 
     wdim = 2 * _LANES
     x = amps.reshape(2, rows, _LANES)
@@ -398,7 +424,8 @@ def _fused_local_run(amps, *, n: int, ops: tuple, sublanes: int,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         grid=(grid,),
         in_specs=[pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
-                               memory_space=pltpu.VMEM)] +
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)] +
                  [pl.BlockSpec((wdim, wdim), lambda i: (0, 0),
                                memory_space=pltpu.VMEM)] * len(ws),
         out_specs=pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
@@ -408,7 +435,7 @@ def _fused_local_run(amps, *, n: int, ops: tuple, sublanes: int,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(x, *ws)
+    )(x, shard_index, *ws)
     return out.reshape(2, -1)
 
 

@@ -115,8 +115,9 @@ def test_small_register_falls_back_to_ordinary_fusion():
 
 
 def test_sharded_register_falls_back_to_engine():
-    """PallasRuns on a multi-device register must route through the
-    sharding-aware engine (pallas_call is not GSPMD-partitioned)."""
+    """PallasRuns whose targets exceed the SHARD-local tile must route
+    through the sharding-aware engine (here: 10q over 8 devices leaves a
+    7-qubit shard, below the one-tile minimum, so shard_map is refused)."""
     import jax
 
     if len(jax.devices()) < 2:
@@ -135,6 +136,47 @@ def test_sharded_register_falls_back_to_engine():
     assert abs(qt.calcTotalProb(qureg) - 1.0) < TOL
 
     ref = qt.createQureg(10, qt.createQuESTEnv(jax.devices()[:1]))
+    qt.initPlusState(ref)
+    circ.run(ref)
+    np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
+                               atol=TOL, rtol=TOL)
+
+
+def test_sharded_pallas_runs_via_shard_map():
+    """VERDICT round 1, next-round #4: PallasRuns survive sharding. A plan
+    built with shard_devices runs the fused kernel PER SHARD under
+    shard_map (sharded-qubit controls/diagonals resolve against the shard
+    index in-kernel); amplitudes must match the single-device path."""
+    import jax
+
+    from quest_tpu import fusion
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the multi-device CPU mesh")
+    ndev = 4
+    n = 12  # 10-qubit shards: >= one (2, 2^3, 128) tile each
+    env = qt.createQuESTEnv(jax.devices()[:ndev])
+    qureg = qt.createQureg(n, env)
+    qt.initPlusState(qureg)
+
+    from __graft_entry__ import _random_layers
+    circ = Circuit(n)
+    _random_layers(circ, n, depth=2)
+    circ.controlledPhaseShift(n - 1, 0, 0.37)   # sharded control in-kernel
+    circ.multiRotateZ(list(range(n)), 0.21)     # parity across shard bits
+    fz = circ.fused(max_qubits=5, pallas=True, shard_devices=ndev)
+    runs = [a[0] for f, a, _ in fz._tape if f.__name__ == "_apply_pallas_run"]
+    assert runs, "plan produced no PallasRuns"
+    # at least one run is shard-executable end-to-end
+    shell = qt.Qureg(n, False, qureg.amps, env=None)
+    got_any = any(
+        fusion._shard_map_pallas_run(shell, ops) is not None for ops in runs)
+    assert got_any, "no run took the shard_map path"
+
+    fz.run(qureg)
+    assert len(qureg.amps.sharding.device_set) == ndev
+
+    ref = qt.createQureg(n, qt.createQuESTEnv(jax.devices()[:1]))
     qt.initPlusState(ref)
     circ.run(ref)
     np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
