@@ -233,19 +233,37 @@ def createPauliHamilFromFile(path: str) -> PauliHamil:
     QuEST.h:914): each line is ``coeff code code ... code`` with one code per
     qubit; the qubit count is inferred from the first line."""
     func = "createPauliHamilFromFile"
+    try:
+        f = open(path)
+    except OSError:
+        validation.validate_file_opened(False, path, func)
     coeffs, codes = [], []
-    with open(path) as f:
+    with f:
         for line in f:
             parts = line.split()
             if not parts:
                 continue
-            coeffs.append(float(parts[0]))
-            codes.append([int(float(c)) for c in parts[1:]])
-    validation._assert(len(coeffs) > 0, "Could not parse the PauliHamil file.", func)
-    num_qubits = len(codes[0])
-    validation._assert(num_qubits > 0, "Could not parse the PauliHamil file.", func)
+            try:
+                coeffs.append(float(parts[0]))
+            except ValueError:
+                validation.validate_hamil_file_coeff_parsed(False, path, func)
+            row = []
+            for c in parts[1:]:
+                try:
+                    v = float(c)
+                except ValueError:
+                    validation.validate_hamil_file_pauli_parsed(False, path, func)
+                validation._assert(v == int(v), "Failed to parse the next "
+                                   f"expected Pauli code in PauliHamil file ({path}).",
+                                   func)
+                validation.validate_hamil_file_pauli_code(int(v), path, func)
+                row.append(int(v))
+            codes.append(row)
+    num_qubits = len(codes[0]) if codes else 0
+    validation.validate_hamil_file_params(num_qubits, len(coeffs), path, func)
     validation._assert(all(len(c) == num_qubits for c in codes),
-                       "Could not parse the PauliHamil file.", func)
+                       "Failed to parse the next expected Pauli code in "
+                       f"PauliHamil file ({path}).", func)
     hamil = PauliHamil(num_qubits, len(coeffs), np.asarray(codes), np.asarray(coeffs))
     validation.validate_pauli_hamil(hamil, func)
     return hamil

@@ -50,6 +50,16 @@ class QuESTEnv:
     def rank(self) -> int:
         return 0  # single-controller SPMD: there is one logical process
 
+    @property
+    def requires_sharding(self) -> bool:
+        """True when registers MUST shard over the mesh: multi-host
+        (jax.distributed) execution, where every process owns devices and a
+        replicated-on-one-device fallback is impossible. Single-host meshes
+        replicate registers too small to split instead of rejecting them
+        (more permissive than the reference's >=1-amp-per-node rule,
+        QuEST_validation.c:368-377, which applies here only multi-host)."""
+        return jax.process_count() > 1
+
     def sharding(self, num_amps: int) -> Optional[NamedSharding]:
         """Block-partition a planar (2, num_amps) amplitude array over the
         mesh (the top log2(numDevices) qubits), as statevec_createQureg's

@@ -209,11 +209,9 @@ def diagonalUnitary(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
     """Apply a SubDiagonalOp as a unitary (diagonalUnitary, QuEST.h:1444)."""
     func = "diagonalUnitary"
     V.validate_multi_targets(qureg, targets, func)
-    V._assert(op.num_qubits == len(targets),
-              "The diagonal operator must act upon the same number of qubits as specified.", func)
+    V.validate_sub_diag_op_targets(op, len(targets), func)
+    V.validate_unitary_sub_diag_op(op, qureg.eps, func)
     elems = np.asarray(op.elems)
-    V._assert(bool(np.all(np.abs(np.abs(elems) - 1) < 100 * qureg.eps)),
-              "The diagonal operator is not unitary.", func)
     _apply_gate_diag(qureg, elems, tuple(targets))
     if _log(qureg):
         _log(qureg).record_comment(
@@ -478,6 +476,7 @@ def multiQubitUnitary(qureg: Qureg, targets, u) -> None:
     """General dense unitary (QuEST.h:5193); the kernel every gate reduces to."""
     func = "multiQubitUnitary"
     V.validate_multi_targets(qureg, targets, func)
+    V.validate_matrix_init(u, func)
     V.validate_unitary_matrix(u, len(targets), qureg.eps, func)
     _apply_gate_matrix(qureg, u, tuple(targets))
     if _log(qureg):
@@ -487,6 +486,7 @@ def multiQubitUnitary(qureg: Qureg, targets, u) -> None:
 def controlledMultiQubitUnitary(qureg: Qureg, control: int, targets, u) -> None:
     func = "controlledMultiQubitUnitary"
     V.validate_multi_controls_multi_targets(qureg, (control,), targets, func)
+    V.validate_matrix_init(u, func)
     V.validate_unitary_matrix(u, len(targets), qureg.eps, func)
     _apply_gate_matrix(qureg, u, tuple(targets), (control,))
     if _log(qureg):
@@ -497,6 +497,7 @@ def multiControlledMultiQubitUnitary(qureg: Qureg, controls, targets, u) -> None
     """(QuEST.h:5366; reference dispatch QuEST_cpu_distributed.c:1526-1568)."""
     func = "multiControlledMultiQubitUnitary"
     V.validate_multi_controls_multi_targets(qureg, controls, targets, func)
+    V.validate_matrix_init(u, func)
     V.validate_unitary_matrix(u, len(targets), qureg.eps, func)
     _apply_gate_matrix(qureg, u, tuple(targets), tuple(controls))
     if _log(qureg):
@@ -533,7 +534,7 @@ def collapseToOutcome(qureg: Qureg, target: int, outcome: int) -> float:
     V.validate_target(qureg, target, func)
     V.validate_outcome(outcome, func)
     prob = _prob_of_outcome(qureg, target, outcome)
-    V._assert(prob > qureg.eps, "Can't collapse to state with zero probability.", func)
+    V.validate_measurement_prob(prob, qureg.eps, func)
     _collapse(qureg, target, outcome, prob)
     if qureg.qasm_log is not None:
         qureg.qasm_log.record_comment(

@@ -89,6 +89,7 @@ def applyMatrix4(qureg: Qureg, t1: int, t2: int, u) -> None:
 def applyMatrixN(qureg: Qureg, targets, u) -> None:
     func = "applyMatrixN"
     V.validate_multi_targets(qureg, targets, func)
+    V.validate_matrix_init(u, func)
     V.validate_matrix_size(u, len(targets), func)
     _apply_matrix_left(qureg, u, tuple(targets))
     _record(qureg, "applyMatrixN")
@@ -99,6 +100,7 @@ def applyGateMatrixN(qureg: Qureg, targets, u) -> None:
     requiring unitarity (QuEST.h:6043)."""
     func = "applyGateMatrixN"
     V.validate_multi_targets(qureg, targets, func)
+    V.validate_matrix_init(u, func)
     V.validate_matrix_size(u, len(targets), func)
     _apply_matrix_gate(qureg, u, tuple(targets))
     _record(qureg, "applyGateMatrixN")
@@ -107,6 +109,7 @@ def applyGateMatrixN(qureg: Qureg, targets, u) -> None:
 def applyMultiControlledMatrixN(qureg: Qureg, controls, targets, u) -> None:
     func = "applyMultiControlledMatrixN"
     V.validate_multi_controls_multi_targets(qureg, controls, targets, func)
+    V.validate_matrix_init(u, func)
     V.validate_matrix_size(u, len(targets), func)
     _apply_matrix_left(qureg, u, tuple(targets), tuple(controls))
     _record(qureg, "applyMultiControlledMatrixN")
@@ -116,6 +119,7 @@ def applyMultiControlledGateMatrixN(qureg: Qureg, controls, targets, u) -> None:
     """(QuEST.h:6094)."""
     func = "applyMultiControlledGateMatrixN"
     V.validate_multi_controls_multi_targets(qureg, controls, targets, func)
+    V.validate_matrix_init(u, func)
     V.validate_matrix_size(u, len(targets), func)
     _apply_matrix_gate(qureg, u, tuple(targets), tuple(controls))
     _record(qureg, "applyMultiControlledGateMatrixN")
@@ -288,9 +292,13 @@ def applyProjector(qureg: Qureg, target: int, outcome: int) -> None:
 
 def _phase_func_apply(qureg, qubits_flat, reg_sizes, encoding, coeffs, exponents,
                       terms_per_reg, override_inds, override_phases, func):
+    V.validate_num_subregisters(len(reg_sizes), func)
+    V.validate_multi_reg_bit_encoding(reg_sizes, encoding, func)
     for m, off in zip(reg_sizes, np.cumsum([0] + list(reg_sizes))[:-1]):
         V.validate_multi_targets(qureg, qubits_flat[off:off + m], func)
     n_ovr = len(override_phases)
+    V.validate_num_phase_func_overrides(
+        sum(reg_sizes), n_ovr, single_var=len(reg_sizes) == 1, func=func)
     V.validate_phase_func_overrides(reg_sizes, encoding, override_inds, n_ovr, func)
     nsv = qureg.num_qubits_in_state_vec
     n = qureg.num_qubits_represented
@@ -326,8 +334,8 @@ def applyPhaseFuncOverrides(qureg: Qureg, qubits, encoding, coeffs, exponents,
                             override_inds, override_phases) -> None:
     """(QuEST.h:6518)."""
     func = "applyPhaseFuncOverrides"
-    V._assert(len(coeffs) == len(exponents) and len(coeffs) > 0,
-              "Invalid number of terms in the phase function.", func)
+    V.validate_phase_func_terms(len(qubits), encoding, coeffs, exponents,
+                                list(override_inds), len(override_phases), func)
     _phase_func_apply(qureg, list(qubits), [len(qubits)], encoding, coeffs,
                       exponents, [len(coeffs)], override_inds, override_phases, func)
 
@@ -344,9 +352,12 @@ def applyMultiVarPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_re
                                     override_inds, override_phases) -> None:
     """(QuEST.h:6761)."""
     func = "applyMultiVarPhaseFuncOverrides"
-    V._assert(len(num_qubits_per_reg) > 0, "Invalid number of qubit sub-registers.", func)
-    V._assert(sum(num_terms_per_reg) == len(coeffs) == len(exponents),
-              "Invalid number of terms in the phase function.", func)
+    V.validate_num_subregisters(len(num_qubits_per_reg), func)
+    V._assert(sum(num_terms_per_reg) == len(coeffs) == len(exponents)
+              and all(t > 0 for t in num_terms_per_reg),
+              "Invalid number of terms in the phase function specified. Must be >0.",
+              func)
+    V.validate_multi_var_phase_func_terms(encoding, exponents, func)
     _phase_func_apply(qureg, list(qubits_flat), list(num_qubits_per_reg), encoding,
                       coeffs, exponents, list(num_terms_per_reg),
                       override_inds, override_phases, func)
@@ -381,15 +392,16 @@ def applyParamNamedPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_
     """(QuEST.h:7179)."""
     func = "applyParamNamedPhaseFuncOverrides"
     reg_sizes = [int(m) for m in num_qubits_per_reg]
-    V._assert(len(reg_sizes) > 0, "Invalid number of qubit sub-registers.", func)
+    V.validate_num_subregisters(len(reg_sizes), func)
+    V.validate_phase_func_name(int(func_name), func)
     fn = phaseFunc(int(func_name))
-    if fn in (phaseFunc.DISTANCE, phaseFunc.SCALED_DISTANCE, phaseFunc.INVERSE_DISTANCE,
-              phaseFunc.SCALED_INVERSE_DISTANCE, phaseFunc.SCALED_INVERSE_SHIFTED_DISTANCE,
-              phaseFunc.SCALED_INVERSE_SHIFTED_WEIGHTED_DISTANCE):
-        V._assert(len(reg_sizes) % 2 == 0,
-                  "Phase functions DISTANCE require a paired number of qubit sub-registers.",
-                  func)
+    V.validate_num_regs_distance_phase_func(int(func_name), len(reg_sizes), func)
+    V.validate_multi_reg_bit_encoding(reg_sizes, encoding, func)
+    V.validate_num_named_phase_func_params(int(func_name), len(reg_sizes),
+                                           len(params or []), func)
     n_ovr = len(override_phases)
+    V.validate_num_phase_func_overrides(
+        sum(reg_sizes), n_ovr, single_var=len(reg_sizes) == 1, func=func)
     V.validate_phase_func_overrides(reg_sizes, encoding, override_inds, n_ovr, func)
     for m, off in zip(reg_sizes, np.cumsum([0] + reg_sizes)[:-1]):
         V.validate_multi_targets(qureg, list(qubits_flat)[off:off + m], func)
@@ -423,14 +435,21 @@ def applyParamNamedPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_
 def createDiagonalOp(num_qubits: int, env) -> DiagonalOp:
     func = "createDiagonalOp"
     V.validate_num_qubits(num_qubits, func)
+    V.validate_num_amps_fit_type(num_qubits, False, func)
+    if getattr(env, "requires_sharding", False):
+        V.validate_diag_op_fits_devices(num_qubits, env.mesh.size, func)
     from . import precision
     dt = precision.real_dtype(None)
-    elems = jnp.zeros((2, 1 << num_qubits), dtype=dt)
-    sharding = env.sharding(1 << num_qubits)
-    if sharding is not None:
-        import jax
-        elems = jax.device_put(elems, sharding)
-    return DiagonalOp(num_qubits, elems)
+
+    def alloc():
+        elems = jnp.zeros((2, 1 << num_qubits), dtype=dt)
+        sharding = env.sharding(1 << num_qubits)
+        if sharding is not None:
+            import jax
+            elems = jax.device_put(elems, sharding)
+        return elems
+
+    return DiagonalOp(num_qubits, V.validate_diag_op_allocation(alloc, func))
 
 
 def destroyDiagonalOp(op: DiagonalOp, env=None) -> None:
@@ -448,6 +467,7 @@ def syncDiagonalOp(op: DiagonalOp) -> None:
 
 def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
     func = "initDiagonalOp"
+    V.validate_diag_op_init(op, func)
     reals = np.asarray(reals).reshape(-1)
     imags = np.asarray(imags).reshape(-1)
     V._assert(reals.size == (1 << op.num_qubits) and imags.size == (1 << op.num_qubits),
@@ -462,6 +482,7 @@ def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
 
 def setDiagonalOpElems(op: DiagonalOp, start_ind: int, reals, imags, num_elems: int) -> None:
     func = "setDiagonalOpElems"
+    V.validate_diag_op_init(op, func)
     V.validate_num_elems(op, start_ind, num_elems, func)
     vals = np.stack([np.asarray(reals).reshape(-1)[:num_elems],
                      np.asarray(imags).reshape(-1)[:num_elems]])
@@ -473,10 +494,9 @@ def initDiagonalOpFromPauliHamil(op: DiagonalOp, hamil: PauliHamil) -> None:
     """Hamil of only I/Z terms -> diagonal elements (QuEST.h:1158)."""
     func = "initDiagonalOpFromPauliHamil"
     V.validate_pauli_hamil(hamil, func)
-    V._assert(op.num_qubits == hamil.num_qubits,
-              "The PauliHamil must act on the same number of qubits as the DiagonalOp.", func)
-    V._assert(bool(np.all((hamil.pauli_codes == 0) | (hamil.pauli_codes == 3))),
-              "The PauliHamil contained operators other than PAULI_Z and PAULI_I.", func)
+    V.validate_diag_op_init(op, func)
+    V.validate_hamil_matches_diag_op(hamil, op, func)
+    V.validate_diag_pauli_hamil(hamil, func)
     n = op.num_qubits
     idx = np.arange(1 << n, dtype=np.int64)
     diag = np.zeros(1 << n, dtype=np.float64)
@@ -501,6 +521,7 @@ def createDiagonalOpFromPauliHamilFile(path: str, env) -> DiagonalOp:
 def applyDiagonalOp(qureg: Qureg, op: DiagonalOp) -> None:
     """|psi> -> D|psi>; rho -> D rho (QuEST.h:1282)."""
     func = "applyDiagonalOp"
+    V.validate_diag_op_init(op, func)
     V.validate_diag_op_matches_qureg(qureg, op, func)
     elems = op.elems.astype(qureg.dtype)
     if qureg.is_density_matrix:
@@ -514,6 +535,7 @@ def applyDiagonalOp(qureg: Qureg, op: DiagonalOp) -> None:
 def calcExpecDiagonalOp(qureg: Qureg, op: DiagonalOp) -> complex:
     """(QuEST.h:1314)."""
     func = "calcExpecDiagonalOp"
+    V.validate_diag_op_init(op, func)
     V.validate_diag_op_matches_qureg(qureg, op, func)
     elems = op.elems.astype(qureg.dtype)
     if qureg.is_density_matrix:

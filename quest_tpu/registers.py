@@ -88,17 +88,27 @@ class Qureg:
 
 def _alloc(env: QuESTEnv, num_qubits_sv: int, dtype, index: int = 0) -> jax.Array:
     num_amps = 1 << num_qubits_sv
-    amps = ops_init.init_classical(num_amps, jnp.dtype(dtype), index)
-    sharding = env.sharding(num_amps)
-    if sharding is not None:
-        amps = jax.device_put(amps, sharding)
-    return amps
+
+    def alloc():
+        amps = ops_init.init_classical(num_amps, jnp.dtype(dtype), index)
+        sharding = env.sharding(num_amps)
+        if sharding is not None:
+            amps = jax.device_put(amps, sharding)
+        return amps
+
+    # allocator failures surface through the validation hook, as
+    # validateQuregAllocation (QuEST_cpu.c:1318)
+    return validation.validate_qureg_allocation(alloc, "createQureg")
 
 
 def createQureg(num_qubits: int, env: QuESTEnv, precision_code: int | None = None) -> Qureg:
     """State-vector register in |0...0> (createQureg, QuEST.h:579)."""
     func = "createQureg"
-    validation.validate_num_qubits(num_qubits, func)
+    validation._assert(num_qubits > 0, "Invalid number of qubits. Must create >0.", func)
+    validation.validate_num_amps_fit_type(num_qubits, False, func)
+    if env.requires_sharding:
+        validation.validate_qureg_fits_devices(num_qubits, env.mesh.size,
+                                               False, func)
     dtype = precision.real_dtype(precision_code)
     q = Qureg(num_qubits, False, _alloc(env, num_qubits, dtype), env)
     q.qasm_log = QASMLogger(num_qubits, dtype)
@@ -108,8 +118,11 @@ def createQureg(num_qubits: int, env: QuESTEnv, precision_code: int | None = Non
 def createDensityQureg(num_qubits: int, env: QuESTEnv, precision_code: int | None = None) -> Qureg:
     """Density-matrix register in |0><0| (createDensityQureg, QuEST.h:673)."""
     func = "createDensityQureg"
-    validation.validate_num_qubits(num_qubits, func)
-    validation._assert(num_qubits < 32, "Invalid number of qubits. The given number of qubits cannot be stored.", func)
+    validation._assert(num_qubits > 0, "Invalid number of qubits. Must create >0.", func)
+    validation.validate_num_amps_fit_type(num_qubits, True, func)
+    if env.requires_sharding:
+        validation.validate_qureg_fits_devices(num_qubits, env.mesh.size,
+                                               True, func)
     dtype = precision.real_dtype(precision_code)
     q = Qureg(num_qubits, True, _alloc(env, 2 * num_qubits, dtype), env)
     q.qasm_log = QASMLogger(num_qubits, dtype)

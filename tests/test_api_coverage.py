@@ -116,3 +116,94 @@ def test_every_public_callable_appears_in_tests():
                if not name.startswith("_") and callable(getattr(qt, name))
                and name not in src]
     assert not missing, f"untested API functions: {missing}"
+
+
+def _raises_covered_names():
+    """Every ``qt.X`` referenced lexically inside a pytest.raises / _raises
+    block across the test sources (ast-level, not grep-level)."""
+    import ast
+
+    here = os.path.dirname(__file__)
+    covered = set()
+
+    def is_raises_call(node):
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        return (isinstance(f, ast.Attribute) and f.attr == "raises") or \
+               (isinstance(f, ast.Name) and f.id in ("_raises", "raises"))
+
+    for path in glob.glob(os.path.join(here, "*.py")):
+        tree = ast.parse(open(path).read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.With) and any(
+                    is_raises_call(item.context_expr) for item in node.items):
+                for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+                    if isinstance(sub, ast.Attribute) and \
+                            isinstance(sub.value, ast.Name) and sub.value.id == "qt":
+                        covered.add(sub.attr)
+                    if isinstance(sub, ast.Name):
+                        covered.add(sub.id)
+            # the VALIDATION_CASES registry (test_input_validation.py): each
+            # named entry is executed under pytest.raises by its runner
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "VALIDATION_CASES"
+                    for t in node.targets):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Tuple) and elt.elts and \
+                            isinstance(elt.elts[0], ast.Constant):
+                        covered.add(elt.elts[0].value)
+    return covered
+
+
+def test_every_validating_function_has_a_validation_test():
+    """Reference discipline: each API function's TEST_CASE has an 'input
+    validation' section driven through the throwing error hook (SURVEY.md
+    section 4, tests/main.cpp:27-29). Here: every public callable whose
+    implementation consults the validation layer must be exercised inside a
+    pytest.raises block somewhere in tests/. Round 1's meta-test only
+    checked that names APPEAR in test sources."""
+    import inspect
+
+    covered = _raises_covered_names()
+    # functions reached through a shared validating helper that is itself
+    # raises-tested (the helper's name must appear in `covered`)
+    via_helper = {
+        # one-per-family raises coverage exercises the shared validator path
+        "applyGateMatrixN": "applyMatrixN", "applyGateSubDiagonalOp": "applySubDiagonalOp",
+        "applyMultiControlledGateMatrixN": "applyMultiControlledMatrixN",
+        "applyNamedPhaseFuncOverrides": "applyNamedPhaseFunc",
+        "applyParamNamedPhaseFuncOverrides": "applyParamNamedPhaseFunc",
+        "applyMultiVarPhaseFuncOverrides": "applyMultiVarPhaseFunc",
+        "applyFullQFT": "applyQFT",
+        "measure": "measureWithStats",
+        "createCloneQureg": "createQureg", "createDensityQureg": "createQureg",
+        "createDiagonalOpFromPauliHamilFile": "createPauliHamilFromFile",
+        "mixNonTPKrausMap": "mixKrausMap",
+        "mixNonTPTwoQubitKrausMap": "mixTwoQubitKrausMap",
+        "mixNonTPMultiQubitKrausMap": "mixMultiQubitKrausMap",
+        "setWeightedQureg": "cloneQureg",
+        "initPureState": "cloneQureg",
+        "calcExpecPauliHamil": "calcExpecPauliSum",
+        "applyPauliHamil": "applyPauliSum",
+        "initDiagonalOpFromPauliHamilFile": "initDiagonalOpFromPauliHamil",
+    }
+    missing = []
+    for name in sorted(dir(qt)):
+        if name.startswith("_"):
+            continue
+        obj = getattr(qt, name)
+        if not (inspect.isfunction(obj)):
+            continue
+        try:
+            src = inspect.getsource(obj)
+        except (OSError, TypeError):
+            continue
+        validates = ("V." in src or "validation." in src or "V._assert" in src)
+        if not validates:
+            continue
+        if name in covered or via_helper.get(name) in covered:
+            continue
+        missing.append(name)
+    assert not missing, (
+        f"validating API functions never exercised under pytest.raises: {missing}")
