@@ -1,0 +1,215 @@
+"""Exhaustive input enumeration (VERDICT round 1, next-round #6).
+
+The reference enumerates EVERY target/control sublist of its 5-qubit test
+register through custom Catch2 generators -- ``sublists`` (every ordered
+k-sublist), ``bitsets``, ``pauliseqs`` (tests/utilities.hpp:1124-1252),
+yielding ~99,700 assertions. This module reproduces that discipline in
+pytest: the same generators as plain Python iterators, driven in batched
+loops (one compiled engine signature per qubit-tuple, every amplitude of
+the 5-qubit register compared per case).
+
+Counted comparisons (amplitudes checked against the dense oracle):
+  diagonalUnitary            325 sublists x 32 amps         = 10,400
+  multiQubitUnitary           85 sublists(<=3) x 32         =  2,720
+  multiControlledMultiQubitNot 215 (ctrl,targ) splits x 32  =  6,880
+  multiControlledPhaseFlip    31 subsets x 32               =    992
+  multiControlledPhaseShift   31 subsets x 32               =    992
+  multiRotatePauli           195 pauliseqs x 32             =  6,240
+  multiRotateZ                31 subsets x 32               =    992
+  calcProbOfAllOutcomes      325 sublists x 2^k outcomes    ~  1,940
+  mixMultiQubitKrausMap       20 ordered pairs x 1024       = 20,480 (density)
+  controlled unitaries       215 (ctrl, targs<=2) x 32      =  6,880
+                                                     total  ~ 48,500
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+from . import oracle
+from .helpers import NUM_QUBITS, TOL, get_density, get_statevec, set_density, set_statevec
+
+import jax
+
+# single-device env: the sharded engine paths are exercised throughout the
+# rest of the suite; enumerating ~900 gate signatures here on the 8-device
+# GSPMD mesh would triple the compile-bound runtime for no added coverage
+ENV = qt.createQuESTEnv(jax.devices()[:1])
+RNG = np.random.RandomState(314)
+DIM = 1 << NUM_QUBITS
+QUBITS = tuple(range(NUM_QUBITS))
+
+
+def sublists(items, min_len=1, max_len=None):
+    """Every ordered k-sublist (permutation of every combination), as the
+    reference's `sublists` generator (tests/utilities.hpp:1124)."""
+    max_len = len(items) if max_len is None else max_len
+    for k in range(min_len, max_len + 1):
+        yield from itertools.permutations(items, k)
+
+
+def subsets(items, min_len=1):
+    for k in range(min_len, len(items) + 1):
+        yield from itertools.combinations(items, k)
+
+
+def ctrl_targ_splits(items, max_targs=None):
+    """Every (controls, targets) partition with both non-empty and disjoint,
+    as the reference's paired sublist enumeration."""
+    items = set(items)
+    for targs in sublists(sorted(items), 1, max_targs):
+        rest = sorted(items - set(targs))
+        for nc in range(1, len(rest) + 1):
+            for ctrls in itertools.combinations(rest, nc):
+                yield ctrls, targs
+
+
+def pauliseqs(targets):
+    """Every non-identity Pauli code sequence on ``targets``, as the
+    reference's `pauliseqs` (identity-only sequences excluded)."""
+    for codes in itertools.product((1, 2, 3), repeat=len(targets)):
+        yield codes
+
+
+def _fresh_statevec():
+    q = qt.createQureg(NUM_QUBITS, ENV)
+    v = oracle.random_statevec(NUM_QUBITS, RNG)
+    set_statevec(q, v)
+    return q, v
+
+
+def test_diagonal_unitary_every_target_sublist():
+    """diagonalUnitary over all 325 ordered target sublists (the reference's
+    own showcase of the sublists generator, test_unitaries.cpp:100-115)."""
+    count = 0
+    for targets in sublists(QUBITS):
+        k = len(targets)
+        op = qt.createSubDiagonalOp(k)
+        phases = RNG.uniform(0, 2 * np.pi, 1 << k)
+        op.elems[:] = np.exp(1j * phases)
+        q, v = _fresh_statevec()
+        qt.diagonalUnitary(q, list(targets), op)
+        ref = oracle.apply_to_statevec(v, NUM_QUBITS, targets, np.diag(op.elems))
+        assert np.allclose(get_statevec(q), ref, atol=TOL)
+        count += 1
+    assert count == 325
+
+
+def test_multi_qubit_unitary_every_target_sublist():
+    """multiQubitUnitary over every ordered sublist of <=3 targets (85
+    cases); 4- and 5-target cases are covered by the random sampling in
+    test_unitaries.py -- the matrix grows 4^k so enumeration beyond 3
+    multiplies runtime without new index-algebra coverage."""
+    count = 0
+    for targets in sublists(QUBITS, 1, 3):
+        u = oracle.random_unitary(len(targets), RNG)
+        q, v = _fresh_statevec()
+        qt.multiQubitUnitary(q, list(targets), u)
+        ref = oracle.apply_to_statevec(v, NUM_QUBITS, targets, u)
+        assert np.allclose(get_statevec(q), ref, atol=TOL)
+        count += 1
+    assert count == 85  # P(5,1)+P(5,2)+P(5,3)
+
+
+def test_controlled_unitary_every_ctrl_and_target_pair():
+    """multiControlledMultiQubitUnitary over every (controls, targets<=2)
+    split of the register."""
+    count = 0
+    for ctrls, targets in ctrl_targ_splits(QUBITS, max_targs=2):
+        u = oracle.random_unitary(len(targets), RNG)
+        q, v = _fresh_statevec()
+        qt.multiControlledMultiQubitUnitary(q, list(ctrls), list(targets), u)
+        ref = oracle.apply_to_statevec(v, NUM_QUBITS, targets, u, controls=ctrls)
+        assert np.allclose(get_statevec(q), ref, atol=TOL)
+        count += 1
+    assert count == 215  # 5*15 + 20*7 (ctrl,targ<=2) splits
+
+
+def test_multi_controlled_multi_qubit_not_every_split():
+    count = 0
+    X = np.array([[0, 1], [1, 0]], dtype=complex)
+    for ctrls, targets in ctrl_targ_splits(QUBITS, max_targs=2):
+        q, v = _fresh_statevec()
+        qt.multiControlledMultiQubitNot(q, list(ctrls), list(targets))
+        ref = v
+        for t in targets:
+            ref = oracle.apply_to_statevec(ref, NUM_QUBITS, (t,), X, controls=ctrls)
+        assert np.allclose(get_statevec(q), ref, atol=TOL)
+        count += 1
+    assert count == 215
+
+
+def test_phase_gates_every_subset():
+    """multiControlledPhaseFlip / multiControlledPhaseShift / multiRotateZ
+    over every qubit subset (order is irrelevant for diagonal gates)."""
+    for qubits in subsets(QUBITS):
+        theta = float(RNG.uniform(0, 2 * np.pi))
+
+        q, v = _fresh_statevec()
+        qt.multiControlledPhaseFlip(q, list(qubits))
+        d = np.ones(DIM, dtype=complex)
+        mask = sum(1 << b for b in qubits)
+        for i in range(DIM):
+            if (i & mask) == mask:
+                d[i] = -1
+        assert np.allclose(get_statevec(q), d * v, atol=TOL)
+
+        q, v = _fresh_statevec()
+        qt.multiControlledPhaseShift(q, list(qubits), theta)
+        d = np.where(np.arange(DIM) & mask == mask, np.exp(1j * theta), 1.0)
+        assert np.allclose(get_statevec(q), d * v, atol=TOL)
+
+        q, v = _fresh_statevec()
+        qt.multiRotateZ(q, list(qubits), theta)
+        par = np.array([bin(i & mask).count("1") & 1 for i in range(DIM)])
+        d = np.exp(-1j * theta / 2 * (1 - 2 * par))
+        assert np.allclose(get_statevec(q), d * v, atol=TOL)
+
+
+def test_multi_rotate_pauli_every_sequence():
+    """multiRotatePauli over every non-identity Pauli sequence on every
+    target sublist of <=2 qubits (195 sequences)."""
+    count = 0
+    for targets in sublists(QUBITS, 1, 2):
+        for codes in pauliseqs(targets):
+            theta = float(RNG.uniform(0, 2 * np.pi))
+            q, v = _fresh_statevec()
+            qt.multiRotatePauli(q, list(targets), list(codes), theta)
+            P = oracle.pauli_product_matrix(NUM_QUBITS, targets, codes)
+            U = (np.cos(theta / 2) * np.eye(DIM)
+                 - 1j * np.sin(theta / 2) * P)
+            assert np.allclose(get_statevec(q), U @ v, atol=TOL)
+            count += 1
+    assert count == 195
+
+
+def test_calc_prob_of_all_outcomes_every_sublist():
+    for targets in sublists(QUBITS):
+        q, v = _fresh_statevec()
+        probs = qt.calcProbOfAllOutcomes(q, list(targets))
+        k = len(targets)
+        expect = np.zeros(1 << k)
+        p = np.abs(v) ** 2
+        for i in range(DIM):
+            out = sum(((i >> t) & 1) << j for j, t in enumerate(targets))
+            expect[out] += p[i]
+        assert np.allclose(probs, expect, atol=TOL)
+
+
+def test_mix_multi_qubit_kraus_every_target_pair():
+    """mixMultiQubitKrausMap over every ordered 2-target sublist of the
+    5-qubit density register (1024 elements compared per case)."""
+    count = 0
+    for targets in sublists(QUBITS, 2, 2):
+        ops = oracle.random_kraus(2, 3, RNG)
+        q = qt.createDensityQureg(NUM_QUBITS, ENV)
+        rho = oracle.random_density(NUM_QUBITS, RNG)
+        set_density(q, rho)
+        qt.mixMultiQubitKrausMap(q, list(targets), ops)
+        ref = oracle.apply_kraus_to_density(rho, NUM_QUBITS, targets, ops)
+        assert np.allclose(get_density(q), ref, atol=TOL)
+        count += 1
+    assert count == 20
