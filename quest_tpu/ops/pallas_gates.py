@@ -36,15 +36,23 @@ Ops format (all matrix data static at trace time, baked into the kernel):
                                          ``targets`` (any qubits; grid
                                          members enter the table index as
                                          per-program scalars)
-    ("lane_u", W)                        W: 256x256 real block matrix from
-                                         _fold_lane_ops -- a whole run of
-                                         lane-qubit gates as ONE MXU dot
+    ("lane_u", W)                        W: 256x256 real block matrix --
+                                         a folded run of lane-qubit gates
+                                         as ONE MXU dot (y @ W per row)
+    ("window", lo, span, W)              W: (2*2^span)^2 real block matrix
+                                         [[Ur,-Ui],[Ui,Ur]] -- a folded run
+                                         of gates confined to the sublane
+                                         window [lo, lo+span), applied as
+                                         per-slab W @ y MXU dots
 
-Before the kernel is built, consecutive ops confined to the 7 lane qubits
-are folded host-side into a single 128x128 unitary and applied by one
-in-kernel matmul on the lane axis (MXU), instead of per-gate butterfly
-rolls (VPU) -- the same dense-fusion economics as quest_tpu/fusion.py, one
-level down.
+Before the kernel is built, _fold_zone_ops contracts gates into dense
+per-zone unitaries: the tile's qubits split into the lane zone [0, 7) and
+successive 5-qubit sublane zones, and each zone accumulates the (not
+necessarily consecutive) gates fully contained in it -- open zones commute
+because they touch disjoint qubits -- until a cross-zone op forces a
+flush. Folded zones run on the MXU instead of per-gate butterfly rolls
+(VPU): the same dense-fusion economics as quest_tpu/fusion.py, one level
+down.
 """
 
 from __future__ import annotations
@@ -118,67 +126,124 @@ def _ctrl_scalar_and_mask(controls, states, tile_bits, shape, gbit):
     return scalar, mask
 
 
-def _lane_foldable(op) -> bool:
-    """True if the op acts entirely within the 7 lane qubits."""
+#: width (in qubits) of each sublane fold zone; D = 2^5 gives 64x64 real
+#: block matrices -- small enough to replicate per program, big enough that
+#: a zone absorbs most of a layer's sublane gates
+_ZONE_SPAN = 5
+
+
+def _op_event(op):
+    """Kernel op tuple -> GateEvent (for host-side dense folding)."""
+    from ..fusion import GateEvent
+
     if op[0] == "matrix":
-        return op[1] < LANE_BITS and all(c < LANE_BITS for c in op[2])
-    if op[0] == "parity":
-        return (all(q < LANE_BITS for q in op[1])
-                and all(c < LANE_BITS for c in op[2]))
+        return GateEvent("matrix", (op[1],), tuple(op[2]), tuple(op[3]),
+                         matrix=np.asarray(op[4].arr if hasattr(op[4], "arr")
+                                           else op[4]))
     if op[0] == "swap":
-        return (op[1] < LANE_BITS and op[2] < LANE_BITS
-                and all(c < LANE_BITS for c in op[3]))
+        return GateEvent("swap", (op[1], op[2]), tuple(op[3]), tuple(op[4]))
     if op[0] == "diagw":
-        return (all(q < LANE_BITS for q in op[1])
-                and all(c < LANE_BITS for c in op[2]))
+        return GateEvent("diag", tuple(op[1]), tuple(op[2]),
+                         diag=np.asarray(op[3].arr if hasattr(op[3], "arr")
+                                         else op[3]).reshape(-1))
+    return GateEvent("parity", tuple(op[1]), tuple(op[2]), theta=float(op[3]))
+
+
+def _op_support(op):
+    if op[0] == "matrix":
+        return {op[1], *op[2]}
+    if op[0] == "swap":
+        return {op[1], op[2], *op[3]}
+    if op[0] in ("diagw", "parity"):
+        return {*op[1], *op[2]}
+    return set(range(LANE_BITS))  # lane_u acts on the lane zone
+
+
+def _op_is_diag(op):
+    if op[0] in ("diagw", "parity"):
+        return True
+    if op[0] == "matrix":
+        m = op[4].arr if hasattr(op[4], "arr") else op[4]
+        return complex(m[0][1]) == 0 and complex(m[1][0]) == 0
     return False
 
 
-def _fold_lane_ops(ops) -> tuple:
-    """Contract each run of >=2 consecutive lane-local ops into one
-    ("lane_u", W) entry, where W is the 256x256 real block form
-    [[Ur^T, Ui^T], [-Ui^T, Ur^T]] of the accumulated 128x128 unitary U:
-    with y = (xr | xi) per sublane row, y @ W applies U on the lane axis."""
-    from ..fusion import GateEvent, event_matrix
+def _fold_zone_ops(ops, tile_bits: int) -> tuple:
+    """Contract runs of zone-local ops into dense per-zone matrices.
 
-    lane_qubits = tuple(range(LANE_BITS))
+    The tile's qubits split into the lane zone [0, 7) and successive
+    _ZONE_SPAN-wide sublane zones [7, 12), [12, 17)... Ops fully contained
+    in one zone accumulate into that zone's dense unitary; because distinct
+    zones touch disjoint qubits, the open accumulators commute with each
+    other, so each can keep absorbing gates until an op that OVERLAPS its
+    zone (a cross-zone butterfly, parity, or grid-bit-controlled gate)
+    forces a flush. Emission:
+
+      lane zone   -> ("lane_u", W256)  y @ W on the lane axis (MXU)
+      sublane zone-> ("window", lo, span, W_2Dx2D)  per-A W @ y dots (MXU)
+
+    This is the dense-fusion economics of quest_tpu/fusion.py applied
+    inside the kernel: the round-2 profile showed per-gate sublane
+    butterflies cost ~0.4 ms each (VPU) while a whole folded zone costs
+    about one ms-scale MXU pass (BASELINE.md round-2 table). Accumulators
+    holding fewer than 2 non-diagonal ops emit their originals (a butterfly
+    is cheaper than a dot for a single gate)."""
+    from ..fusion import event_matrix
+
+    zones = [(0, LANE_BITS)]
+    lo = LANE_BITS
+    while lo < tile_bits:
+        zones.append((lo, min(lo + _ZONE_SPAN, tile_bits)))
+        lo += _ZONE_SPAN
+
     out = []
-    run = []
+    accum = {z: [] for z in zones}   # zone -> [op]
 
-    def flush():
-        if len(run) < 2:
+    def zone_of(op):
+        s = _op_support(op)
+        for z in zones:
+            if all(z[0] <= q < z[1] for q in s):
+                return z
+        return None
+
+    def flush(z):
+        run = accum[z]
+        if not run:
+            return
+        # threshold tuned on the 26q bench: folding zones holding a single
+        # partner-exchange gate measured SLOWER end-to-end (2268 vs 2604
+        # gates/s) -- inside a long run an extra 64x64 zone dot costs more
+        # than one amortised butterfly -- so a zone folds only once it holds
+        # >=2 non-diagonal gates
+        if sum(not _op_is_diag(o) for o in run) < 2:
             out.extend(run)
             run.clear()
             return
-        U = np.eye(1 << LANE_BITS, dtype=complex)
+        qubits = tuple(range(z[0], z[1]))
+        U = np.eye(1 << len(qubits), dtype=complex)
         for op in run:
-            if op[0] == "matrix":
-                ev = GateEvent("matrix", (op[1],), tuple(op[2]), tuple(op[3]),
-                               matrix=np.asarray(op[4].arr if hasattr(op[4], "arr")
-                                                 else op[4]))
-            elif op[0] == "swap":
-                ev = GateEvent("swap", (op[1], op[2]), tuple(op[3]),
-                               tuple(op[4]))
-            elif op[0] == "diagw":
-                ev = GateEvent("diag", tuple(op[1]), tuple(op[2]),
-                               diag=np.asarray(op[3].arr if hasattr(op[3], "arr")
-                                               else op[3]).reshape(-1))
-            else:
-                ev = GateEvent("parity", tuple(op[1]), tuple(op[2]),
-                               theta=float(op[3]))
-            U = event_matrix(ev, lane_qubits) @ U
+            U = event_matrix(_op_event(op), qubits) @ U
         ur, ui = U.real, U.imag
-        W = np.block([[ur.T, ui.T], [-ui.T, ur.T]])
-        out.append(("lane_u", HashableMatrix(W)))
+        if z[0] == 0:
+            W = np.block([[ur.T, ui.T], [-ui.T, ur.T]])
+            out.append(("lane_u", HashableMatrix(W)))
+        else:
+            W = np.block([[ur, -ui], [ui, ur]])
+            out.append(("window", z[0], z[1] - z[0], HashableMatrix(W)))
         run.clear()
 
     for op in ops:
-        if _lane_foldable(op):
-            run.append(op)
-        else:
-            flush()
-            out.append(op)
-    flush()
+        z = zone_of(op)
+        if z is not None:
+            accum[z].append(op)
+            continue
+        s = _op_support(op)
+        for z2 in zones:
+            if any(z2[0] <= q < z2[1] for q in s):
+                flush(z2)
+        out.append(op)
+    for z in zones:
+        flush(z)
     return tuple(out)
 
 
@@ -228,6 +293,27 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None):
                             precision=jax.lax.Precision.HIGHEST)
                 xr = y[:, :_LANES]
                 xi = y[:, _LANES:]
+
+            elif op[0] == "window":
+                # dense folded unitary on sublane window [lo, lo+span):
+                # view the tile as (A, D, B*128) and hit each A-slab with
+                # one (2D, 2D) @ (2D, B*128) MXU dot (W = [[Ur,-Ui],[Ui,Ur]])
+                _, wi, lo, span = op
+                W = w_refs[wi][:]
+                d = 1 << span
+                blk = (1 << (lo - LANE_BITS)) * _LANES
+                a_cnt = (shape[0] * shape[1]) // (d * blk)
+                xr4 = xr.reshape(a_cnt, d, blk)
+                xi4 = xi.reshape(a_cnt, d, blk)
+                outs_r, outs_i = [], []
+                for a in range(a_cnt):
+                    y = jnp.concatenate([xr4[a], xi4[a]], axis=0)
+                    o = jnp.dot(W, y, preferred_element_type=y.dtype,
+                                precision=jax.lax.Precision.HIGHEST)
+                    outs_r.append(o[:d])
+                    outs_i.append(o[d:])
+                xr = jnp.concatenate(outs_r, axis=0).reshape(shape)
+                xi = jnp.concatenate(outs_i, axis=0).reshape(shape)
 
             elif op[0] == "matrix":
                 _, q, controls, states, M = op
@@ -381,7 +467,8 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
     else:
         shard_index = jnp.asarray(shard_index, jnp.int32).reshape(1)
         local_n = n
-    return _fused_local_run(amps, shard_index, n=n, ops=_fold_lane_ops(ops),
+    return _fused_local_run(amps, shard_index, n=n,
+                            ops=_fold_zone_ops(ops, lq),
                             sublanes=sublanes, interpret=bool(interpret),
                             local_n=local_n)
 
@@ -406,6 +493,9 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
         if o[0] == "lane_u":
             ops_r.append(("lane_u", len(ws)))
             ws.append(jnp.asarray(np.asarray(o[1].arr.real, dtype=amps.dtype)))
+        elif o[0] == "window":
+            ops_r.append(("window", len(ws), o[1], o[2]))
+            ws.append(jnp.asarray(np.asarray(o[3].arr.real, dtype=amps.dtype)))
         elif o[0] == "matrix":
             ops_r.append((o[0], o[1], o[2], o[3],
                           np.asarray(o[4].arr if hasattr(o[4], "arr") else o[4])))
@@ -417,7 +507,6 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
     kernel = _make_kernel(tuple(ops_r), s_bits, tile_bits, np.dtype(amps.dtype),
                           local_n=local_n)
 
-    wdim = 2 * _LANES
     x = amps.reshape(2, rows, _LANES)
     out = pl.pallas_call(
         kernel,
@@ -426,8 +515,8 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
         in_specs=[pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
                                memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.SMEM)] +
-                 [pl.BlockSpec((wdim, wdim), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM)] * len(ws),
+                 [pl.BlockSpec(w.shape, lambda i: (0, 0),
+                               memory_space=pltpu.VMEM) for w in ws],
         out_specs=pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
                                memory_space=pltpu.VMEM),
         # long fused runs accumulate per-gate temporaries past the default

@@ -1,0 +1,76 @@
+"""Per-item profiling of the two-frame plan: times each PallasRun and
+FrameSwap of the bench circuit individually (loop-inside-jit), and prints
+the op composition of each run -- the breakdown that tells where a block's
+milliseconds go."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(a):
+    return float(jax.device_get(a.reshape(-1)[0]))
+
+
+def timeit(fn, amps, reps=20):
+    @jax.jit
+    def looped(x):
+        for _ in range(reps):
+            x = fn(x)
+        return x
+
+    amps = looped(amps)
+    sync(amps)
+    t0 = time.perf_counter()
+    amps = looped(amps)
+    sync(amps)
+    return (time.perf_counter() - t0) / reps, amps
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 26
+    from __graft_entry__ import _random_layers
+    from quest_tpu import fusion
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.ops.pallas_gates import (_fold_zone_ops, fused_local_run,
+                                            local_qubits, swap_bit_blocks)
+
+    circ = Circuit(n)
+    _random_layers(circ, n, 8)
+    tb = local_qubits(n)
+    p = fusion.plan(tuple(circ._tape), n, np.dtype("float32"), 5,
+                    pallas_tile_bits=tb)
+
+    amps = jnp.zeros((2, 1 << n), jnp.float32).at[0, 0].set(1.0)
+    total = 0.0
+    for i, item in enumerate(p.items):
+        if isinstance(item, fusion.PallasRun):
+            folded = _fold_zone_ops(item.ops, tb)
+            comp = Counter(o[0] for o in folded)
+            dt, amps = timeit(
+                lambda x, ops=item.ops: fused_local_run(x, n=n, ops=ops), amps)
+            print(f"[{i:2d}] run  {dt*1e3:7.3f} ms  {len(item.ops):3d} ops -> "
+                  f"{dict(comp)}")
+        elif isinstance(item, fusion.FrameSwap):
+            dt, amps = timeit(
+                lambda x: swap_bit_blocks(x, n=n, lo1=item.tile_bits - item.k,
+                                          lo2=item.tile_bits, k=item.k), amps)
+            print(f"[{i:2d}] swap {dt*1e3:7.3f} ms")
+        else:
+            print(f"[{i:2d}] OTHER {type(item).__name__}")
+            continue
+        total += dt
+    print(f"total {total*1e3:.1f} ms per circuit pass")
+
+
+if __name__ == "__main__":
+    main()
