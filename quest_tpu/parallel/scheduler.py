@@ -45,6 +45,25 @@ class DistributedScheduler:
         "pair_exchanges": 0, "relocation_swaps": 0, "rank_permutes": 0,
         "comm_free": 0, "local": 0, "channel_superops": 0})
 
+    def comm_volume(self, n: int, bytes_per_amp: int = 8) -> dict:
+        """Trace-time communication-volume estimate for the collected plan,
+        per device, mirroring the reference's comm cost model (BASELINE.md:
+        a non-local 1q gate exchanges a full chunk send+recv per rank,
+        QuEST_cpu_distributed.c:495-533; a relocation/odd-parity swap moves
+        half a chunk each way, :1443-1459; an X-class rank permute
+        re-routes the full chunk). ``bytes_per_amp`` = 8 for planar f32
+        (re+im), 16 for f64."""
+        chunk = (1 << n) // self.mesh.size
+        s = self.stats
+        amps_moved = chunk * (2.0 * s["pair_exchanges"]
+                              + 1.0 * s["relocation_swaps"]
+                              + 2.0 * s["rank_permutes"])
+        return {
+            "amps_per_device": amps_moved,
+            "bytes_per_device": amps_moved * bytes_per_amp,
+            "chunk_amps": chunk,
+        }
+
     # -- dense matrices -----------------------------------------------------
 
     def apply_matrix(self, amps, matrix, *, n, targets, controls=(),
@@ -155,11 +174,18 @@ def plan_circuit(circuit, mesh: Mesh):
     """Trace ``circuit`` abstractly under the explicit scheduler and return
     its communication plan stats (no device execution -- jax.eval_shape)."""
     import jax
+    import numpy as np
 
     from ..precision import real_dtype
 
-    num_amps = 1 << ((2 if circuit.is_density_matrix else 1) * circuit.num_qubits)
+    nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
+    num_amps = 1 << nsv
     with explicit_mesh(mesh) as sched:
         fn = circuit.as_fn()
         jax.eval_shape(fn, jax.ShapeDtypeStruct((2, num_amps), real_dtype(None)))
-    return dict(sched.stats) if sched else {}
+    if sched is None:
+        return {}
+    out = dict(sched.stats)
+    out["comm_volume"] = sched.comm_volume(
+        nsv, bytes_per_amp=2 * np.dtype(real_dtype(None)).itemsize)
+    return out

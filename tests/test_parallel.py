@@ -205,3 +205,22 @@ def test_explicit_density_channels_on_circuit_tape():
     with qt.explicit_mesh(ENV.mesh):
         circ.run(q)
     np.testing.assert_allclose(qt.get_np(q), qt.get_np(q_ref), atol=TOL)
+
+
+def test_plan_comm_volume_model():
+    """plan_circuit reports the per-device communication volume using the
+    reference's cost model (full-chunk send+recv per non-local 1q gate,
+    half-chunk each way per relocation swap -- BASELINE.md comm table)."""
+    n = 5
+    circ = qt.Circuit(n)
+    circ.hadamard(n - 1)          # 1 pair exchange
+    circ.hadamard(n - 1)          # 1 more
+    circ.swapGate(1, n - 1)       # 1 mixed relocation swap
+    stats = plan_circuit(circ, ENV.mesh)
+    cv = stats["comm_volume"]
+    chunk = (1 << n) // ENV.mesh.size
+    assert cv["chunk_amps"] == chunk
+    assert cv["amps_per_device"] == chunk * (2.0 * 2 + 1.0 * 1)
+    from quest_tpu.precision import real_dtype
+    bytes_per_amp = 2 * np.dtype(real_dtype(None)).itemsize  # planar (re, im)
+    assert cv["bytes_per_device"] == cv["amps_per_device"] * bytes_per_amp
