@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -103,7 +104,10 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
 
     circ = build_circuit(n, depth)
     num_gates = len(circ)
-    fused = circ.fused(max_qubits=5, pallas=True)
+    # small states: pure XLA fusion (everything inlines into one program;
+    # a pallas_call is an opaque barrier that only pays off once the state
+    # is HBM-resident and bandwidth-bound)
+    fused = circ.fused(max_qubits=5, pallas=n >= 22)
     print(f"# {n}q: fused {num_gates} gates -> {len(fused)} blocks",
           file=sys.stderr)
     if len(fused) > 48:
@@ -175,8 +179,6 @@ def main() -> None:
     if args.smoke:
         args.qubits, args.depth = 12, 2
 
-    import os
-
     import jax
 
     # amortise the slow remote AOT compiles across runs
@@ -197,15 +199,51 @@ def main() -> None:
                                         sync)))
         return
 
-    # all milestone configs (BASELINE.json "configs"); headline = 26q
+    # all milestone configs (BASELINE.json "configs"); headline = 26q.
+    # The density config's COLD compile can take many minutes through the
+    # remote AOT tunnel (2^28-amp Kraus programs); run it in a budgeted
+    # subprocess so one slow compile cannot sink the whole bench artifact
+    # (the persistent .jax_cache makes the next attempt fast).
     configs = []
     for n in (20, 24, 26):
         configs.append(bench_statevec(n, args.depth, args.reps, sync))
-    configs.append(bench_density(14, args.reps, sync))
+    configs.append(_budgeted_density(args.reps, budget_s=420))
     configs.append(plan_34q_distributed())
     headline = dict(configs[2])
     headline["configs"] = configs
     print(json.dumps(headline))
+
+
+def _budgeted_density(reps: int, budget_s: int) -> dict:
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--config", "density",
+           "--reps", str(reps)]
+    def failed(note):
+        return {
+            "metric": "channel-ops/sec, 14-qubit density matrix "
+                      "(mixDepolarising+mixKrausMap)",
+            "value": None,
+            "unit": "ops/sec",
+            "vs_baseline": None,
+            "note": note,
+        }
+
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=budget_s, cwd=os.path.dirname(
+                                 os.path.abspath(__file__)))
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        return failed("density bench produced no JSON "
+                      f"(rc={out.returncode}): {out.stderr[-400:]}")
+    except subprocess.TimeoutExpired:
+        return failed(f"cold compile exceeded the {budget_s}s budget; "
+                      "rerun with a warm .jax_cache (bench.py --config density)")
+    except Exception as e:  # any other failure must not sink the artifact
+        return failed(f"density bench subprocess failed: {e}")
 
 
 if __name__ == "__main__":

@@ -68,7 +68,13 @@ def main():
             circ.controlledNot(q, q + 1)
         circ.controlledPhaseFlip(0, n - 1)
 
-    fused = circ.fused(max_qubits=5)
+    # two-frame Pallas planning sized for the shard-local state: fused runs
+    # execute per shard under shard_map (sharded-qubit controls/diagonals
+    # resolve against the shard index in-kernel); gates no frame localises
+    # fall back to the sharding-aware engine automatically
+    use_pallas = jax.default_backend() == "tpu"
+    fused = circ.fused(max_qubits=5, pallas=use_pallas,
+                       shard_devices=shards if use_pallas else None)
     fn = fused.compiled_blocks(max_gates=24, donate=True)
 
     t0 = time.time()
