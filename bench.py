@@ -106,7 +106,10 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
     num_gates = len(circ)
     # small states: pure XLA fusion (everything inlines into one program;
     # a pallas_call is an opaque barrier that only pays off once the state
-    # is HBM-resident and bandwidth-bound)
+    # is HBM-resident and bandwidth-bound), and 4x the reps -- sub-ms
+    # circuits are dispatch-bound, so short runs measure tunnel jitter
+    if n < 22:
+        reps *= 4
     fused = circ.fused(max_qubits=5, pallas=n >= 22)
     print(f"# {n}q: fused {num_gates} gates -> {len(fused)} blocks",
           file=sys.stderr)

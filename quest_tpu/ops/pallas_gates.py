@@ -68,9 +68,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE_BITS = 7          # minor dim fixed at 128 lanes
 _LANES = 1 << LANE_BITS
-#: (2, 1024, 128) f32 tile = 1 MiB; 2048 sublanes was measured to blow the
-#: 16 MiB scoped-VMEM budget once the kernel's per-gate temporaries pile up.
-_DEF_SUBLANES = 1 << 10
+#: (2, 2048, 128) f32 tile = 2 MiB. Swept on the 26q bench: S=1024 -> 2604
+#: gates/s, S=2048 -> 2699, S=4096 -> 2432; larger tiles amortise per-program
+#: DMA overhead until block size outgrows the pipeline. Needs the raised
+#: Mosaic VMEM limit in _fused_local_run (the 16 MiB default OOMs).
+_DEF_SUBLANES = 1 << 11
 
 
 def local_qubits(n: int, sublanes: int = _DEF_SUBLANES) -> int:
