@@ -291,7 +291,8 @@ def applyProjector(qureg: Qureg, target: int, outcome: int) -> None:
 # ---------------------------------------------------------------------------
 
 def _phase_func_apply(qureg, qubits_flat, reg_sizes, encoding, coeffs, exponents,
-                      terms_per_reg, override_inds, override_phases, func):
+                      terms_per_reg, override_inds, override_phases, func,
+                      multi_var=False):
     V.validate_num_subregisters(len(reg_sizes), func)
     V.validate_multi_reg_bit_encoding(reg_sizes, encoding, func)
     for m, off in zip(reg_sizes, np.cumsum([0] + list(reg_sizes))[:-1]):
@@ -321,7 +322,16 @@ def _phase_func_apply(qureg, qubits_flat, reg_sizes, encoding, coeffs, exponents
         amps = PF.apply_poly_phase(amps, coeffs_d, ovr_i, ovr_p,
                                    n=nsv, qubits=shifted, conj=True, **args)
     qureg.put(amps)
-    _record(qureg, func)
+    if qureg.qasm_log is not None:
+        if not multi_var:
+            qureg.qasm_log.record_phase_func(
+                list(qubits_flat), encoding, list(coeffs), list(exponents),
+                list(override_inds), list(override_phases))
+        else:
+            qureg.qasm_log.record_multi_var_phase_func(
+                list(qubits_flat), list(reg_sizes), encoding, list(coeffs),
+                list(exponents), list(terms_per_reg), list(override_inds),
+                list(override_phases))
 
 
 def applyPhaseFunc(qureg: Qureg, qubits, encoding, coeffs, exponents) -> None:
@@ -360,7 +370,7 @@ def applyMultiVarPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_re
     V.validate_multi_var_phase_func_terms(encoding, exponents, func)
     _phase_func_apply(qureg, list(qubits_flat), list(num_qubits_per_reg), encoding,
                       coeffs, exponents, list(num_terms_per_reg),
-                      override_inds, override_phases, func)
+                      override_inds, override_phases, func, multi_var=True)
 
 
 def applyNamedPhaseFunc(qureg: Qureg, qubits_flat, num_qubits_per_reg, encoding,
@@ -425,7 +435,11 @@ def applyParamNamedPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_
         amps = PF.apply_named_phase(amps, params_d, ovr_i, ovr_p,
                                     n=nsv, qubits=shifted, conj=True, **args)
     qureg.put(amps)
-    _record(qureg, func)
+    if qureg.qasm_log is not None:
+        qureg.qasm_log.record_named_phase_func(
+            list(qubits_flat), reg_sizes, encoding, int(func_name),
+            list(params) if params else [], list(override_inds),
+            list(override_phases))
 
 
 # ---------------------------------------------------------------------------

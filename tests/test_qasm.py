@@ -272,3 +272,49 @@ def test_param_format_matches_precision():
     log64.start()
     log64.record_param_gate("rotateZ", 0, math.pi)
     assert "Rz(3.1415926535898) q[0];" in log64.printed()
+
+
+def test_phase_func_recorded_as_reference_comments():
+    """Phase functions render as the reference's structured comment blocks
+    (qasm_recordPhaseFunc / MultiVar / Named, QuEST_qasm.c:485-868)."""
+    q = qt.createQureg(4, ENV)
+    qt.startRecordingQASM(q)
+    qt.applyPhaseFuncOverrides(q, [0, 1], 0, [-0.5, 1.3], [2.0, -1.5],
+                               [0], [0.45])
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    assert "// Here, applyPhaseFunc() multiplied a complex scalar of the form" in text
+    assert "//     exp(i (-0.5 x^2 + 1.3 x^(-1.5)))" in text
+    assert "upon every substate |x>, informed by qubits (under an unsigned binary encoding)" in text
+    assert "//     {0, 1}" in text
+    assert "//     |0> -> exp(i 0.45)" in text
+
+    q = qt.createQureg(4, ENV)
+    qt.startRecordingQASM(q)
+    qt.applyMultiVarPhaseFunc(q, [0, 1, 2, 3], [2, 2], 0,
+                              [0.5, -1.0], [2.0, 3.0], [1, 1])
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    assert "// Here, applyMultiVarPhaseFunc() multiplied a complex scalar of the form" in text
+    assert "//          + 0.5 x^2" in text
+    assert "//          - 1 y^3 ))" in text
+    assert "//     |x> = {0, 1}" in text
+    assert "//     |y> = {2, 3}" in text
+
+    q = qt.createQureg(4, ENV)
+    qt.startRecordingQASM(q)
+    qt.applyParamNamedPhaseFunc(q, [0, 1, 2, 3], [2, 2], 0,
+                                qt.phaseFunc.SCALED_INVERSE_NORM, [-2.0, 0.1])
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    assert "// Here, applyNamedPhaseFunc() multiplied a complex scalar of form" in text
+    assert "//     exp(i (-2) / sqrt(x^2 + y^2))" in text
+
+    q = qt.createQureg(4, ENV)
+    qt.startRecordingQASM(q)
+    qt.applyNamedPhaseFuncOverrides(q, [0, 1, 2, 3], [2, 2], 0,
+                                    qt.phaseFunc.DISTANCE, [2, 1], [-0.5])
+    qt.stopRecordingQASM(q)
+    text = _recorded(q)
+    assert "//     exp(i sqrt((x-y)^2))" in text
+    assert "//     |x=2, y=1> -> exp(i (-0.5))" in text
