@@ -75,7 +75,14 @@ def main():
     use_pallas = jax.default_backend() == "tpu"
     fused = circ.fused(max_qubits=5, pallas=use_pallas,
                        shard_devices=shards if use_pallas else None)
-    fn = fused.compiled_blocks(max_gates=24, donate=True)
+
+    # compiled_blocks bypasses Circuit.run, so build it under the execution
+    # mesh (the block executables pin the ambient contexts at build time)
+    from quest_tpu import fusion as _fusion
+    from quest_tpu.circuits import _register_mesh
+
+    with _fusion.pallas_mesh(_register_mesh(qureg)):
+        fn = fused.compiled_blocks(max_gates=24, donate=True)
 
     t0 = time.time()
     amps = fn(qureg.amps)

@@ -78,6 +78,20 @@ def _resolve(name):
         f"(measurement and calc* functions must run eagerly)")
 
 
+def _register_mesh(qureg):
+    """The 1-D amps mesh the register is actually sharded over, or None."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .environment import AMP_AXIS
+
+    sharding = getattr(qureg.amps, "sharding", None)
+    if (isinstance(sharding, NamedSharding)
+            and sharding.spec == PartitionSpec(None, AMP_AXIS)
+            and sharding.mesh.size > 1):
+        return sharding.mesh
+    return None
+
+
 class Circuit:
     """Deferred-execution circuit over ``num_qubits`` qubits.
 
@@ -143,18 +157,20 @@ class Circuit:
         mesh -- entering/leaving ``explicit_mesh`` retraces rather than
         silently replaying the other mode's executable.
         """
+        from . import fusion
         from .parallel import scheduler as _dist
         sched = _dist.active()
         mesh = sched.mesh if sched else None
-        key = (donate, mesh)
+        pmesh = fusion.active_pallas_mesh()
+        key = (donate, mesh, pmesh)
         if key not in self._compiled:
             inner = jax.jit(self.as_fn(), donate_argnums=(0,) if donate else ())
 
-            def fn(amps, _inner=inner, _mesh=mesh):
+            def fn(amps, _inner=inner, _mesh=mesh, _pmesh=pmesh):
                 # jit traces on first *call*, which may happen under a
-                # different scheduler context than the one this executable is
-                # keyed on -- pin the mode captured here before invoking.
-                with _dist.explicit_mesh(_mesh):
+                # different scheduler/pallas-mesh context than the one this
+                # executable is keyed on -- pin the modes captured here.
+                with _dist.explicit_mesh(_mesh), fusion.pallas_mesh(_pmesh):
                     return _inner(amps)
 
             self._compiled[key] = fn
@@ -175,7 +191,9 @@ class Circuit:
         per dense block. ``shard_devices`` plans for execution on a register
         sharded over that many devices: the tile limit shrinks to the
         shard-local size so every emitted run is per-shard executable under
-        shard_map (fusion._shard_map_pallas_run).
+        shard_map (fusion._shard_map_pallas_run); Circuit.run keeps that
+        per-shard path active inside the jitted replay by deriving the
+        execution mesh from the register it is given (fusion.pallas_mesh).
         """
         import numpy as np
 
@@ -226,9 +244,11 @@ class Circuit:
         """Like :meth:`compiled`, but as a chain of block-sized executables.
         Cached like :meth:`compiled` so repeated calls reuse the underlying
         executables instead of retracing every block."""
+        from . import fusion
         from .parallel import scheduler as _dist
         sched = _dist.active()
-        key = (("blocks", max_gates), donate, sched.mesh if sched else None)
+        key = (("blocks", max_gates), donate, sched.mesh if sched else None,
+               fusion.active_pallas_mesh())
         if key not in self._compiled:
             fns = [b.compiled(donate=donate) for b in self.blocks(max_gates)]
 
@@ -247,5 +267,7 @@ class Circuit:
             raise ValueError(
                 f"Circuit({self.num_qubits}q, density={self.is_density_matrix}) "
                 f"cannot run on {qureg!r}")
-        qureg.put(self.compiled()(qureg.amps))
+        from . import fusion
+        with fusion.pallas_mesh(_register_mesh(qureg)):
+            qureg.put(self.compiled()(qureg.amps))
         return qureg

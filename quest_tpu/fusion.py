@@ -619,6 +619,31 @@ def _plan_pallas(tape, num_qubits: int, dtype, max_qubits: int,
     return out
 
 
+import threading
+
+_PALLAS_MESH = threading.local()
+
+
+@contextlib.contextmanager
+def pallas_mesh(mesh):
+    """Ambient execution mesh for PallasRuns inside jit traces, where the
+    amps tracer hides its sharding. Circuit.run derives it from the actual
+    register and activates it around the traced replay, so a fused plan is
+    never bound to one device set; set it manually only when calling a
+    compiled replay directly on a sharded register (see
+    examples/distributed_34q.py)."""
+    prev = getattr(_PALLAS_MESH, "mesh", None)
+    _PALLAS_MESH.mesh = mesh
+    try:
+        yield
+    finally:
+        _PALLAS_MESH.mesh = prev
+
+
+def active_pallas_mesh():
+    return getattr(_PALLAS_MESH, "mesh", None)
+
+
 def _apply_pallas_run(qureg, ops: tuple, tile_bits: int) -> None:
     """Tape-entry wrapper for a PallasRun (state-vector registers only; the
     density shadow would target qubits >= tile_bits, which the kernel cannot
@@ -634,8 +659,21 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int) -> None:
     from .ops.pallas_gates import fused_local_run
     from .parallel import scheduler as _dist
 
+    import jax
+
     assert not qureg.is_density_matrix
-    sharding = getattr(qureg.amps, "sharding", None)
+    amps = qureg.amps
+    mesh = active_pallas_mesh()
+    if (mesh is not None and mesh.size > 1 and _dist.active() is None
+            and isinstance(amps, jax.core.Tracer)):
+        # inside a jit trace the tracer hides its sharding; use the ambient
+        # mesh, which Circuit.run derived from the register actually being
+        # replayed (so it always matches the traced input's sharding)
+        new = _run_pallas_sharded(qureg, ops, mesh)
+        if new is not None:
+            qureg.put(new)
+            return
+    sharding = getattr(amps, "sharding", None)
     if sharding is not None and len(sharding.device_set) > 1:
         if _dist.active() is None:
             new = _shard_map_pallas_run(qureg, ops)
@@ -649,10 +687,27 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int) -> None:
 
 
 def _shard_map_pallas_run(qureg, ops: tuple):
-    """Run a PallasRun per-shard over the register's 1-D amps mesh, or None
-    if the run isn't shard-executable. The kernel invocation is legal
-    because amplitude sharding splits off the TOP qubits: each shard is a
-    contiguous (2, 2^n_local) sub-state on which in-tile targets pair
+    """Eager-path entry: run a PallasRun per-shard over the mesh of the
+    register's own (concrete) sharding, or None if the layout or the run
+    isn't shard-executable."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .environment import AMP_AXIS
+
+    sharding = qureg.amps.sharding
+    if not isinstance(sharding, NamedSharding):
+        return None
+    if sharding.spec != P(None, AMP_AXIS):
+        return None
+    return _run_pallas_sharded(qureg, ops, sharding.mesh)
+
+
+def _run_pallas_sharded(qureg, ops: tuple, mesh):
+    """shard_map the fused kernel over ``mesh`` if every op is executable
+    against the shard-local tile; None otherwise.
+
+    Legality: amplitude sharding splits off the TOP qubits, so each shard
+    is a contiguous (2, 2^n_local) sub-state on which in-tile targets pair
     locally, while sharded-qubit controls/diagonals/parity members depend
     only on the shard index (jax.lax.axis_index -> the kernel's SMEM
     scalar). One HBM pass per device, zero communication -- the fusion
@@ -660,19 +715,12 @@ def _shard_map_pallas_run(qureg, ops: tuple):
     exchanges (QuEST_cpu_distributed.c:870-905)."""
     import jax
     from jax import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from .environment import AMP_AXIS
     from .ops import pallas_gates as PG
 
-    amps = qureg.amps
-    sharding = amps.sharding
-    if not isinstance(sharding, NamedSharding):
-        return None
-    mesh = sharding.mesh
     if tuple(mesh.shape.keys()) != (AMP_AXIS,):
-        return None
-    if sharding.spec != P(None, AMP_AXIS):
         return None
     ndev = mesh.shape[AMP_AXIS]
     if ndev & (ndev - 1):
@@ -699,7 +747,7 @@ def _shard_map_pallas_run(qureg, ops: tuple):
     # annotation, which the checker (on by default) rejects
     fn = shard_map(body, mesh=mesh, in_specs=P(None, AMP_AXIS),
                    out_specs=P(None, AMP_AXIS), check_vma=False)
-    return fn(amps)
+    return fn(qureg.amps)
 
 
 def _apply_ops_via_engine(qureg, ops: tuple) -> None:

@@ -227,3 +227,38 @@ def test_window_alignment_in_pallas_mode():
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
     np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
                                np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
+
+
+def test_sharded_pallas_inside_jitted_replay():
+    """Circuit.run derives the execution mesh from the register it is
+    given (fusion.pallas_mesh), so PallasRuns keep the per-shard shard_map
+    path inside the jitted replay, where the amps tracer hides its
+    sharding -- and the same fused plan still runs on single-device
+    registers (nothing is baked into the plan)."""
+    import jax
+
+    from quest_tpu import fusion
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the multi-device CPU mesh")
+    ndev = 4
+    n = 12
+    env = qt.createQuESTEnv(jax.devices()[:ndev])
+    qureg = qt.createQureg(n, env)
+    qt.initPlusState(qureg)
+
+    from __graft_entry__ import _random_layers
+    circ = Circuit(n)
+    _random_layers(circ, n, depth=2)
+    fz = circ.fused(max_qubits=5, pallas=True, shard_devices=ndev)
+    runs = [a for f, a, _ in fz._tape if f.__name__ == "_apply_pallas_run"]
+    assert runs
+
+    fz.run(qureg)  # jitted replay: run() derives the mesh from the register
+    assert len(qureg.amps.sharding.device_set) == ndev
+
+    ref = qt.createQureg(n, qt.createQuESTEnv(jax.devices()[:1]))
+    qt.initPlusState(ref)
+    circ.run(ref)
+    np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
+                               atol=TOL, rtol=TOL)
