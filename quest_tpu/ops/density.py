@@ -101,24 +101,107 @@ def apply_channel(amps, superop, *, n: int, targets: tuple[int, ...]):
             km = jnp.asarray(np.stack([k.real, k.imag]), dtype=amps.dtype)
             t = sched.apply_matrix(amps + 0, km, n=2 * n, targets=tuple(targets))
             t = sched.apply_matrix(t, km, n=2 * n, targets=shifted, conj=True)
-            t = sign * t if sign != 1.0 else t
-            out = t if out is None else out + t
+            out = _acc_kraus_term(out, sign, t)
         return out
+    if len(targets) == 1 and jax.default_backend() == "tpu":
+        # non-TPU backends stay on the XLA engine path: fused_local_run
+        # would fall into the Pallas interpreter there, which is orders of
+        # magnitude slower than _apply_kraus_sum at these sizes
+        new = _kraus_sum_pallas(amps, terms, n, targets[0])
+        if new is not None:
+            return new
     signs = tuple(s for s, _ in terms)
     ks = np.stack([np.stack([k.real, k.imag]) for _, k in terms])
     return _apply_kraus_sum(amps, jnp.asarray(ks, dtype=amps.dtype),
                             n=n, targets=tuple(targets), signs=signs)
 
 
+def _kraus_sum_pallas(amps, terms, n, t, lq=None):
+    """Single-target Kraus sum with each term as ONE fused Pallas pass
+    (K on the row qubit + conj(K) on the column qubit in the same HBM
+    read+write), or None when the path doesn't apply (multi-device,
+    non-TPU without interpret, sub-tile state).
+
+    The column qubit t+n usually sits above the tile (the density state
+    has 2n qubits); it is then relocated into the tile by a single-bit
+    block-swap transpose with a free in-tile qubit, the channel applied
+    there, and swapped back -- the single-chip analogue of the reference's
+    half-chunk density exchanges (QuEST_cpu_distributed.c:535-868), and
+    the same relocation idea as the two-frame planner, one qubit at a
+    time. Cost: #terms + (0 or 2) passes vs the engine path's 2 x #terms
+    window GEMMs. ``lq`` overrides the tile limit for tests."""
+    import jax
+
+    from .. import fusion as _fusion
+    from . import pallas_gates as PG
+
+    nsv = 2 * n
+    if amps.shape[-1] < 2 * PG._LANES:
+        return None
+    sharding = getattr(amps, "sharding", None)
+    if sharding is not None and len(sharding.device_set) > 1:
+        return None  # pallas_call would gather the shards
+    if (isinstance(amps, jax.core.Tracer)
+            and _fusion.active_pallas_mesh() is not None):
+        return None  # traced replay of a register known to be sharded
+    if lq is None:
+        lq = PG.local_qubits(nsv)
+    c = t + n
+    if t >= lq:
+        return None  # row qubit itself above the tile: engine path
+    swap = None
+    if c >= lq:
+        # free in-tile relocation slot, >= LANE_BITS so the block-swap
+        # transpose keeps a wide contiguous inner dimension
+        slot = next((q for q in range(lq - 1, PG.LANE_BITS - 1, -1)
+                     if q != t), None)
+        if slot is None:
+            return None
+        swap = (slot, c)
+        c = slot
+    terms_h = tuple((float(s), PG.HashableMatrix(k)) for s, k in terms)
+    return _kraus_sum_pallas_run(amps + 0, n=n, t=t, c=c, swap=swap,
+                                 terms=terms_h)
+
+
+def _acc_kraus_term(out, sign, term):
+    """out + sign * term (None-seeded), the shared Kraus accumulator."""
+    term = sign * term if sign != 1.0 else term
+    return term if out is None else out + term
+
+
+@partial(jax.jit, static_argnames=("n", "t", "c", "swap", "terms"),
+         donate_argnums=(0,))
+def _kraus_sum_pallas_run(amps, *, n, t, c, swap, terms):
+    """One compiled program for the whole fused-Kraus channel: optional
+    relocation swap, every per-term kernel pass, the signed accumulation,
+    and the swap back -- XLA elides the intermediate copies and the caller
+    pays one dispatch instead of ~3 per term."""
+    from . import pallas_gates as PG
+
+    nsv = 2 * n
+    if swap is not None:
+        amps = PG.swap_bit_blocks(amps, n=nsv, lo1=swap[0], lo2=swap[1], k=1)
+    out = None
+    for sign, k in terms:
+        ops = (("matrix", t, (), (), k),
+               ("matrix", c, (), (), PG.HashableMatrix(np.conj(k.arr))))
+        out = _acc_kraus_term(out, sign,
+                              PG.fused_local_run(amps + 0, n=nsv, ops=ops))
+    if swap is not None:
+        out = PG.swap_bit_blocks(out, n=nsv, lo1=swap[0], lo2=swap[1], k=1)
+    return out
+
+
 @partial(jax.jit, static_argnames=("n", "targets", "signs"), donate_argnums=(0,))
 def _apply_kraus_sum(amps, ks, *, n: int, targets: tuple[int, ...],
                      signs: tuple[float, ...]):
     shifted = tuple(q + n for q in targets)
-    out = jnp.zeros_like(amps)
+    out = None
     for i, sign in enumerate(signs):
         t = apply.apply_matrix(amps + 0, ks[i], n=2 * n, targets=targets)
         t = apply.apply_matrix(t, ks[i], n=2 * n, targets=shifted, conj=True)
-        out = out + (sign * t if sign != 1.0 else t)
+        out = _acc_kraus_term(out, sign, t)
     return out
 
 

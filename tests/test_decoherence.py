@@ -198,3 +198,38 @@ def test_kraus_sum_path_matches_superop(monkeypatch):
     monkeypatch.setattr(DN, "_SUPEROP_MAX_QUBITS", 0)
     b = DN.apply_channel(ref_amps, S, n=4, targets=(1,))
     np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=TOL)
+
+
+def test_kraus_sum_pallas_matches_engine_both_relocation_branches():
+    """The fused per-term Kraus path (ops/density._kraus_sum_pallas) must
+    match the engine Kraus-sum with the column qubit in-tile AND relocated
+    via the single-bit block swap (lq override forces the latter)."""
+    import jax.numpy as jnp
+
+    from quest_tpu.ops import density as DN
+
+    rng = np.random.RandomState(12)
+    n = 6
+    N = 1 << (2 * n)
+    x = rng.randn(N) + 1j * rng.randn(N)
+    amps = jnp.asarray(np.stack([x.real, x.imag]))
+
+    X = np.array([[0, 1], [1, 0]], dtype=complex)
+    non_cp = (DN.kraus_superoperator([np.sqrt(0.8) * np.eye(2)])
+              - 0.3 * DN.kraus_superoperator([X]))  # negative Choi weight
+    sups = [DN.kraus_superoperator(DN.depolarising_kraus(0.3)),
+            DN.kraus_superoperator(DN.damping_kraus(0.4)), non_cp]
+    for sup in sups:
+        terms = DN.choi_kraus(sup)
+        ks = jnp.asarray(np.stack([np.stack([k.real, k.imag])
+                                   for _, k in terms]), amps.dtype)
+        signs = tuple(s for s, _ in terms)
+        if sup is non_cp:
+            assert any(s < 0 for s, _ in terms)  # the sign path is exercised
+        for t, lq in [(1, None), (3, 9), (0, 8)]:
+            got = DN._kraus_sum_pallas(amps, terms, n, t, lq=lq)
+            assert got is not None, (t, lq)
+            ref = DN._apply_kraus_sum(amps + 0, ks, n=n, targets=(t,),
+                                      signs=signs)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       atol=1e-6)
