@@ -78,18 +78,24 @@ def _resolve(name):
         f"(measurement and calc* functions must run eagerly)")
 
 
-def _register_mesh(qureg):
-    """The 1-D amps mesh the register is actually sharded over, or None."""
+def _amps_mesh(amps):
+    """The 1-D amps mesh a (concrete) amplitude array is sharded over, or
+    None for single-device / traced arrays."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     from .environment import AMP_AXIS
 
-    sharding = getattr(qureg.amps, "sharding", None)
+    sharding = getattr(amps, "sharding", None)
     if (isinstance(sharding, NamedSharding)
             and sharding.spec == PartitionSpec(None, AMP_AXIS)
             and sharding.mesh.size > 1):
         return sharding.mesh
     return None
+
+
+def _register_mesh(qureg):
+    """The 1-D amps mesh the register is actually sharded over, or None."""
+    return _amps_mesh(qureg.amps)
 
 
 class Circuit:
@@ -170,7 +176,12 @@ class Circuit:
                 # jit traces on first *call*, which may happen under a
                 # different scheduler/pallas-mesh context than the one this
                 # executable is keyed on -- pin the modes captured here.
-                with _dist.explicit_mesh(_mesh), fusion.pallas_mesh(_pmesh):
+                # With no ambient pallas mesh, derive it from the concrete
+                # amps so calling compiled() directly on a sharded register
+                # behaves like run() (Pallas/Kraus paths would otherwise
+                # trace meshless and GSPMD-gather the shards onto one device)
+                pm = _pmesh if _pmesh is not None else _amps_mesh(amps)
+                with _dist.explicit_mesh(_mesh), fusion.pallas_mesh(pm):
                     return _inner(amps)
 
             self._compiled[key] = fn

@@ -294,9 +294,20 @@ class PallasRun:
     """A run of tile-local 1-qubit matrices / parity phases executed in ONE
     Pallas HBM pass (ops.pallas_gates.fused_local_run). Gate targets must be
     below ``tile_bits``; controls and parity members may be any qubit.
-    Ops are in PHYSICAL coordinates (after any active FrameSwap)."""
+    Ops are in PHYSICAL coordinates (after any active frame swap).
+
+    ``load_swap_k`` / ``store_swap_k`` fold the frame-switch transpose into
+    this run's input gather / output scatter (zero extra HBM passes; see
+    ops.pallas_gates._swap_spec): nonzero k means the amps arrive in (or
+    must be left in) the OTHER frame and the kernel's block specs perform
+    the relabeling during DMA. When the executing register cannot take the
+    folded path (sharded, mismatched tile geometry), the swap runs as an
+    explicit swap_bit_blocks pass instead -- same semantics, one extra
+    bandwidth pass (round 2's scheme)."""
     ops: tuple
     tile_bits: int
+    load_swap_k: int = 0
+    store_swap_k: int = 0
 
 
 @dataclass
@@ -376,6 +387,11 @@ def _lower_event(ev: GateEvent):
             return [_POp("matrix", tuple(ev.targets), ctrls, states,
                          np.diag(ev.diag), True)]
         if len(ev.targets) <= 5:
+            if any(s == 0 for s in states):
+                # the kernel diagw op has no control-state slot; an
+                # anti-controlled wide diagonal must not silently drop its
+                # states -- run the entry through the ordinary engine
+                return None
             return [_POp("diagw", tuple(ev.targets), ctrls, (),
                          np.asarray(ev.diag).reshape(-1), True)]
         return None
@@ -427,11 +443,16 @@ class _FramePlanner:
     def _emit_run(self, frame: int, ops: list):
         if not ops:
             return
+        load_k = 0
         if self.cur_frame != frame and self.k > 0:
-            self.out.items.append(FrameSwap(self.tb, self.k))
+            # the frame switch folds into this run's input gather; the
+            # executor falls back to an explicit swap_bit_blocks pass when
+            # the register's geometry can't take the folded DMA
+            load_k = self.k
             self.cur_frame = frame
         self.out.items.append(PallasRun(
-            tuple(self._phys_op(op, frame) for op in ops), self.tb))
+            tuple(self._phys_op(op, frame) for op in ops), self.tb,
+            load_swap_k=load_k))
 
     def _phys_op(self, op: _POp, frame: int):
         from .ops.pallas_gates import HashableMatrix
@@ -457,7 +478,13 @@ class _FramePlanner:
         self._emit_run(*self.open)
         self._emit_run(*self.next)
         if self.cur_frame != 0 and self.k > 0:
-            self.out.items.append(FrameSwap(self.tb, self.k))
+            last = self.out.items[-1] if self.out.items else None
+            if isinstance(last, PallasRun) and last.store_swap_k == 0:
+                # fold the return-to-identity swap into the final run's
+                # output scatter instead of a standalone transpose pass
+                last.store_swap_k = self.k
+            else:  # pragma: no cover - runs always precede a frame-1 state
+                self.out.items.append(FrameSwap(self.tb, self.k))
             self.cur_frame = 0
         self.open = (0, [])
         self.next = (1, [])
@@ -644,7 +671,8 @@ def active_pallas_mesh():
     return getattr(_PALLAS_MESH, "mesh", None)
 
 
-def _apply_pallas_run(qureg, ops: tuple, tile_bits: int) -> None:
+def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
+                      load_swap_k: int = 0, store_swap_k: int = 0) -> None:
     """Tape-entry wrapper for a PallasRun (state-vector registers only; the
     density shadow would target qubits >= tile_bits, which the kernel cannot
     pair -- density tapes never produce PallasRuns, see Circuit.fused).
@@ -655,13 +683,33 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int) -> None:
     the kernel -- see fused_local_run's shard_index). Otherwise (explicit
     scheduler active, non-canonical sharding, or a target the shard can't
     pair) ops replay through the sharding-aware engine gate-by-gate.
+
+    Frame swaps annotated on the run (load/store_swap_k) execute folded
+    into the kernel's DMA when the register is single-device and the tile
+    geometry matches the plan (zero extra passes); every other path gets
+    an explicit swap_bit_blocks pass before/after -- identical semantics.
     """
-    from .ops.pallas_gates import fused_local_run
+    from .ops import pallas_gates as PG
+    from .ops.pallas_gates import fused_local_run, swap_bit_blocks
     from .parallel import scheduler as _dist
 
     import jax
 
     assert not qureg.is_density_matrix
+    nsv = qureg.num_qubits_in_state_vec
+
+    def pre_swap():
+        if load_swap_k:
+            qureg.put(swap_bit_blocks(
+                qureg.amps, n=nsv, lo1=tile_bits - load_swap_k,
+                lo2=tile_bits, k=load_swap_k))
+
+    def post_swap():
+        if store_swap_k:
+            qureg.put(swap_bit_blocks(
+                qureg.amps, n=nsv, lo1=tile_bits - store_swap_k,
+                lo2=tile_bits, k=store_swap_k))
+
     amps = qureg.amps
     mesh = active_pallas_mesh()
     if (mesh is not None and mesh.size > 1 and _dist.active() is None
@@ -669,21 +717,43 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int) -> None:
         # inside a jit trace the tracer hides its sharding; use the ambient
         # mesh, which Circuit.run derived from the register actually being
         # replayed (so it always matches the traced input's sharding)
+        pre_swap()
         new = _run_pallas_sharded(qureg, ops, mesh)
         if new is not None:
             qureg.put(new)
+            post_swap()
             return
-    sharding = getattr(amps, "sharding", None)
+        if load_swap_k:  # swap already applied; replay ops via the engine
+            _apply_ops_via_engine(qureg, ops)
+            post_swap()
+            return
+    sharding = getattr(qureg.amps, "sharding", None)
     if sharding is not None and len(sharding.device_set) > 1:
+        pre_swap()
         if _dist.active() is None:
             new = _shard_map_pallas_run(qureg, ops)
             if new is not None:
                 qureg.put(new)
+                post_swap()
                 return
         _apply_ops_via_engine(qureg, ops)
+        post_swap()
         return
-    qureg.put(fused_local_run(qureg.amps, n=qureg.num_qubits_in_state_vec,
-                              ops=ops))
+    # single device: fold the swaps into the kernel DMA when this register's
+    # tile geometry matches the plan's (s_low >= one sublane tile keeps the
+    # gathered chunks layout-free); otherwise run them as explicit passes
+    k_max = max(load_swap_k, store_swap_k)
+    foldable = (k_max > 0
+                and tile_bits == PG.local_qubits(nsv)
+                and tile_bits - PG.LANE_BITS - k_max >= 3)
+    if k_max and not foldable:
+        pre_swap()
+    qureg.put(fused_local_run(
+        qureg.amps, n=nsv, ops=ops,
+        load_swap_k=load_swap_k if foldable else 0,
+        store_swap_k=store_swap_k if foldable else 0))
+    if k_max and not foldable:
+        post_swap()
 
 
 def _shard_map_pallas_run(qureg, ops: tuple):
@@ -843,7 +913,9 @@ def as_tape(p: FusePlan) -> list:
         elif isinstance(item, FusedBlock):
             entries.append((_apply_dense_block, (item.matrix, item.qubits), {}))
         elif isinstance(item, PallasRun):
-            entries.append((_apply_pallas_run, (item.ops, item.tile_bits), {}))
+            entries.append((_apply_pallas_run,
+                            (item.ops, item.tile_bits, item.load_swap_k,
+                             item.store_swap_k), {}))
         elif isinstance(item, FrameSwap):
             entries.append((_apply_frame_swap, (item.tile_bits, item.k), {}))
         else:

@@ -262,7 +262,8 @@ def _keep_factor(controls, states, tile_bits, shape, dtype, gbit):
     return None
 
 
-def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None):
+def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
+                 load_swap=None, store_swap=None):
     """Kernel over (x_ref, hi_ref, *w_refs, o_ref); ops of kind 'lane_u'
     carry an index into w_refs (their 256x256 block matrices arrive as
     operands -- Pallas kernels may not capture array constants).
@@ -272,14 +273,33 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None):
     count): qubit roles at q >= local_n resolve against it, so controls,
     parity members and diagonal targets on SHARDED qubits work in-kernel
     with zero communication -- the Pallas analogue of the scheduler's
-    rank-bit controls (parallel/exchange.py)."""
+    rank-bit controls (parallel/exchange.py).
+
+    ``load_swap``/``store_swap`` = (dk, s_low) fold a frame-swap transpose
+    (swap_bit_blocks of the top-k sublane block with the k-bit grid block)
+    into this pass: the input block arrives frame-permuted (gathered by the
+    BlockSpec from dk strided row-chunks), and/or the output block scatters
+    back the same way. The relabeling then costs zero extra HBM passes --
+    the pass count of a two-frame circuit drops by ~2x (round-3 attack on
+    the reference hot loop QuEST_cpu.c:1682-1739; see fusion._FramePlanner).
+    """
     one = np.array(1, dtype)
 
     def kernel(x_ref, hi_ref, *refs):
         w_refs = refs[:-1]
         o_ref = refs[-1]
-        xr = x_ref[0]
-        xi = x_ref[1]
+        if load_swap is not None:
+            # (2, 1, dk, 1, s_low, 128) block: axis 2 is the (old) grid-bit
+            # block, already sitting where the new frame's high sublane bits
+            # belong -- collapsing (dk, s_low) into the sublane axis IS the
+            # frame swap, and is layout-free when s_low fills >= 1 sublane
+            # tile (the planner guarantees s_low >= 8)
+            dk, s_low = load_swap
+            xr = x_ref[0, 0, :, 0].reshape(dk * s_low, _LANES)
+            xi = x_ref[1, 0, :, 0].reshape(dk * s_low, _LANES)
+        else:
+            xr = x_ref[0]
+            xi = x_ref[1]
         shape = xr.shape
 
         def gbit(q):
@@ -426,14 +446,20 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None):
             else:  # pragma: no cover
                 raise ValueError(f"unknown pallas op {op[0]!r}")
 
-        o_ref[0] = xr
-        o_ref[1] = xi
+        if store_swap is not None:
+            dk, s_low = store_swap
+            o_ref[0, 0, :, 0] = xr.reshape(dk, s_low, _LANES)
+            o_ref[1, 0, :, 0] = xi.reshape(dk, s_low, _LANES)
+        else:
+            o_ref[0] = xr
+            o_ref[1] = xi
 
     return kernel
 
 
 def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
-                    interpret: bool | None = None, shard_index=None):
+                    interpret: bool | None = None, shard_index=None,
+                    load_swap_k: int = 0, store_swap_k: int = 0):
     """Apply ``ops`` (see module doc) to the planar (2, 2^n) state in one
     fused Pallas pass. Every matrix target must satisfy
     ``q < local_qubits(n, sublanes)``; parity members and controls may be
@@ -443,13 +469,23 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
     ``shard_index`` (traced i32 scalar, e.g. ``jax.lax.axis_index`` inside
     shard_map) enables per-shard execution: ``amps`` is then one device's
     shard with ``n`` LOCAL qubits, and op roles on qubits >= n (sharded
-    qubits of the global register) resolve against the shard index."""
+    qubits of the global register) resolve against the shard index.
+
+    ``load_swap_k`` = k > 0 folds ``swap_bit_blocks(lo1=tb-k, lo2=tb, k)``
+    (tb = the tile-bit count of this call's geometry) into the input DMA:
+    the state arrives in the OTHER frame and is relabeled during load, so
+    ``ops`` must already be in this run's frame. ``store_swap_k`` folds the
+    same relabeling into the output DMA (the result lands in the other
+    frame). Either costs zero extra HBM passes. Incompatible with
+    ``shard_index`` (the exchanged grid bits are sharded there)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if amps.shape[-1] < _LANES:
         raise ValueError(
             f"state has {amps.shape[-1]} amplitudes < one {_LANES}-lane tile; "
             f"registers below {LANE_BITS + 1} qubits take the ordinary path")
+    if (load_swap_k or store_swap_k) and shard_index is not None:
+        raise ValueError("folded frame swaps cannot run per-shard")
 
     def _is_diag_matrix(o):
         m = o[4].arr if hasattr(o[4], "arr") else o[4]
@@ -472,20 +508,45 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
     return _fused_local_run(amps, shard_index, n=n,
                             ops=_fold_zone_ops(ops, lq),
                             sublanes=sublanes, interpret=bool(interpret),
-                            local_n=local_n)
+                            local_n=local_n, load_swap_k=int(load_swap_k),
+                            store_swap_k=int(store_swap_k))
+
+
+def _swap_view(x, grid: int, s: int, k: int):
+    """(2, rows, 128) -> the 6-D frame-swap view (2, ghi, dk, dk, s_low, 128)
+    whose middle axes are the k-bit grid block and the top-k sublane block."""
+    dk = 1 << k
+    return x.reshape(2, grid // dk, dk, dk, s >> k, _LANES)
+
+
+def _swap_spec(s: int, k: int):
+    """BlockSpec gathering/scattering one frame-permuted tile per program:
+    for (new-frame) grid index i, all dk positions of the old grid block at
+    old-sublane-block position i % dk -- dk strided (s_low, 128) row-chunks
+    whose concatenation IS the tile in the new frame."""
+    dk = 1 << k
+    return pl.BlockSpec((2, 1, dk, 1, s >> k, _LANES),
+                        lambda i: (0, i // dk, 0, i % dk, 0, 0),
+                        memory_space=pltpu.VMEM)
 
 
 @partial(jax.jit, static_argnames=("n", "ops", "sublanes", "interpret",
-                                  "local_n"),
+                                  "local_n", "load_swap_k", "store_swap_k"),
          donate_argnums=(0,))
 def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
-                     interpret: bool, local_n: int | None):
+                     interpret: bool, local_n: int | None,
+                     load_swap_k: int = 0, store_swap_k: int = 0):
     num = amps.shape[-1]
     rows = max(num >> LANE_BITS, 1)
     s = min(sublanes, rows)
     s_bits = int(math.log2(s)) if s > 1 else 0
     tile_bits = LANE_BITS + s_bits
     grid = rows // s
+    for k in (load_swap_k, store_swap_k):
+        if k and (k > s_bits or (1 << k) > grid):
+            raise ValueError(
+                f"frame-swap k={k} exceeds the call geometry "
+                f"(s_bits={s_bits}, grid={grid})")
 
     # lane_u block matrices become pallas operands (replicated per program);
     # their op entries carry the operand index instead of the matrix
@@ -506,27 +567,40 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
                           np.asarray(o[3].arr if hasattr(o[3], "arr") else o[3])))
         else:
             ops_r.append(o)
-    kernel = _make_kernel(tuple(ops_r), s_bits, tile_bits, np.dtype(amps.dtype),
-                          local_n=local_n)
+    kernel = _make_kernel(
+        tuple(ops_r), s_bits, tile_bits, np.dtype(amps.dtype),
+        local_n=local_n,
+        load_swap=(1 << load_swap_k, s >> load_swap_k) if load_swap_k else None,
+        store_swap=(1 << store_swap_k, s >> store_swap_k) if store_swap_k else None)
 
     x = amps.reshape(2, rows, _LANES)
+    plain = pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM)
+    x_in = _swap_view(x, grid, s, load_swap_k) if load_swap_k else x
+    in_spec0 = _swap_spec(s, load_swap_k) if load_swap_k else plain
+    if store_swap_k:
+        dk = 1 << store_swap_k
+        out_shape = jax.ShapeDtypeStruct(
+            (2, grid // dk, dk, dk, s >> store_swap_k, _LANES), x.dtype)
+        out_spec = _swap_spec(s, store_swap_k)
+    else:
+        out_shape = jax.ShapeDtypeStruct(x.shape, x.dtype)
+        out_spec = plain
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        out_shape=out_shape,
         grid=(grid,),
-        in_specs=[pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
-                               memory_space=pltpu.VMEM),
+        in_specs=[in_spec0,
                   pl.BlockSpec(memory_space=pltpu.SMEM)] +
                  [pl.BlockSpec(w.shape, lambda i: (0, 0),
                                memory_space=pltpu.VMEM) for w in ws],
-        out_specs=pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=out_spec,
         # long fused runs accumulate per-gate temporaries past the default
         # 16 MiB scoped-VMEM budget; the physical VMEM is far larger
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(x, shard_index, *ws)
+    )(x_in, shard_index, *ws)
     return out.reshape(2, -1)
 
 

@@ -86,7 +86,8 @@ class Qureg:
                 f"amps=2^{self.num_qubits_in_state_vec}, dtype={self.amps.dtype})")
 
 
-def _alloc(env: QuESTEnv, num_qubits_sv: int, dtype, index: int = 0) -> jax.Array:
+def _alloc(env: QuESTEnv, num_qubits_sv: int, dtype, index: int = 0,
+           func: str = "createQureg") -> jax.Array:
     num_amps = 1 << num_qubits_sv
 
     def alloc():
@@ -96,9 +97,9 @@ def _alloc(env: QuESTEnv, num_qubits_sv: int, dtype, index: int = 0) -> jax.Arra
             amps = jax.device_put(amps, sharding)
         return amps
 
-    # allocator failures surface through the validation hook, as
-    # validateQuregAllocation (QuEST_cpu.c:1318)
-    return validation.validate_qureg_allocation(alloc, "createQureg")
+    # allocator failures surface through the validation hook, attributed to
+    # the calling API function like validateQuregAllocation (QuEST_cpu.c:1318)
+    return validation.validate_qureg_allocation(alloc, func)
 
 
 def createQureg(num_qubits: int, env: QuESTEnv, precision_code: int | None = None) -> Qureg:
@@ -110,7 +111,7 @@ def createQureg(num_qubits: int, env: QuESTEnv, precision_code: int | None = Non
         validation.validate_qureg_fits_devices(num_qubits, env.mesh.size,
                                                False, func)
     dtype = precision.real_dtype(precision_code)
-    q = Qureg(num_qubits, False, _alloc(env, num_qubits, dtype), env)
+    q = Qureg(num_qubits, False, _alloc(env, num_qubits, dtype, func=func), env)
     q.qasm_log = QASMLogger(num_qubits, dtype)
     return q
 
@@ -124,7 +125,8 @@ def createDensityQureg(num_qubits: int, env: QuESTEnv, precision_code: int | Non
         validation.validate_qureg_fits_devices(num_qubits, env.mesh.size,
                                                True, func)
     dtype = precision.real_dtype(precision_code)
-    q = Qureg(num_qubits, True, _alloc(env, 2 * num_qubits, dtype), env)
+    q = Qureg(num_qubits, True, _alloc(env, 2 * num_qubits, dtype,
+                                       func=func), env)
     q.qasm_log = QASMLogger(num_qubits, dtype)
     return q
 

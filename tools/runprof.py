@@ -54,12 +54,27 @@ def main():
     total = 0.0
     for i, item in enumerate(p.items):
         if isinstance(item, fusion.PallasRun):
+            from quest_tpu.ops.pallas_gates import LANE_BITS
             folded = _fold_zone_ops(item.ops, tb)
             comp = Counter(o[0] for o in folded)
-            dt, amps = timeit(
-                lambda x, ops=item.ops: fused_local_run(x, n=n, ops=ops), amps)
-            print(f"[{i:2d}] run  {dt*1e3:7.3f} ms  {len(item.ops):3d} ops -> "
-                  f"{dict(comp)}")
+            lk, sk = item.load_swap_k, item.store_swap_k
+            # same foldability guard as fusion._apply_pallas_run: profile
+            # what production actually runs (explicit swaps otherwise)
+            if max(lk, sk) and tb - LANE_BITS - max(lk, sk) < 3:
+                def run(x, ops=item.ops, lk=lk, sk=sk):
+                    if lk:
+                        x = swap_bit_blocks(x, n=n, lo1=tb - lk, lo2=tb, k=lk)
+                    x = fused_local_run(x, n=n, ops=ops)
+                    if sk:
+                        x = swap_bit_blocks(x, n=n, lo1=tb - sk, lo2=tb, k=sk)
+                    return x
+            else:
+                def run(x, ops=item.ops, lk=lk, sk=sk):
+                    return fused_local_run(x, n=n, ops=ops,
+                                           load_swap_k=lk, store_swap_k=sk)
+            dt, amps = timeit(run, amps)
+            print(f"[{i:2d}] run  {dt*1e3:7.3f} ms  {len(item.ops):3d} ops "
+                  f"ld={lk} st={sk} -> {dict(comp)}")
         elif isinstance(item, fusion.FrameSwap):
             dt, amps = timeit(
                 lambda x: swap_bit_blocks(x, n=n, lo1=item.tile_bits - item.k,
