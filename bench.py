@@ -38,6 +38,12 @@ import numpy as np
 #: reference QuEST gates/sec on this host (see module docstring)
 REF_GATES_PER_SEC = {20: 422.99, 24: 23.42, 26: 5.86}
 
+#: reference QuEST 14q density channel-ops/sec on this host (same circuit,
+#: tools/ref_bench.c --density 14; measured 2026-07-30, 1-core -O3
+#: -DMULTITHREADED=1 build -- kernels timed: densmatr_mixDepolarisingLocal
+#: QuEST_cpu.c:137-185 and the mixKrausMap superoperator path)
+REF_DENSITY_CHANNEL_OPS_PER_SEC = {14: 0.93}
+
 
 def build_circuit(n: int, depth: int):
     from quest_tpu.circuits import Circuit
@@ -75,7 +81,11 @@ def bench_density(n: int, reps: int, sync) -> dict:
     circ.mixKrausMap(1, kraus)
     circ.mixTwoQubitDephasing(0, 1, 0.1)
     num_ops = len(circ)
-    fn = circ.fused(max_qubits=4).compiled_blocks(max_gates=4, donate=True)
+    # pallas=True: the unitary prefix rides fused kernel runs with explicit
+    # conj-shadow ops (round-3 density fast path); channels stay barriers
+    # on their own fused-Kraus passes
+    fn = circ.fused(max_qubits=4, pallas=True).compiled_blocks(
+        max_gates=4, donate=True)
 
     import time
     amps = rho.amps
@@ -86,12 +96,14 @@ def bench_density(n: int, reps: int, sync) -> dict:
         amps = fn(amps)
     sync(amps)
     dt = time.perf_counter() - t0
+    val = num_ops * reps / dt
+    ref = REF_DENSITY_CHANNEL_OPS_PER_SEC.get(n)
     return {
         "metric": f"channel-ops/sec, {n}-qubit density matrix "
                   f"(mixDepolarising+mixKrausMap)",
-        "value": round(num_ops * reps / dt, 2),
+        "value": round(val, 2),
         "unit": "ops/sec",
-        "vs_baseline": None,
+        "vs_baseline": round(val / ref, 3) if ref else None,
     }
 
 

@@ -17,6 +17,10 @@ typedef double qreal;
 #define REAL_EPS 1e-13
 #define REAL_SPECIFIER "%lf"
 #define REAL_QASM_SPECIFIER "%g"
+/* printf formats for qreal, as the reference PRECISION=2 block
+ * (QuEST/include/QuEST_precision.h:61-64) */
+#define REAL_STRING_FORMAT "%.14f"
+#define REAL_QASM_FORMAT "%.14g"
 
 #define absReal(X) fabs(X)
 

@@ -196,10 +196,11 @@ class Circuit:
         captured as gate primitives (decoherence, phase functions, inits)
         pass through unchanged and act as fusion barriers.
 
-        ``pallas=True`` (state-vector tapes only) additionally routes gate
-        runs through the fused Pallas kernel (ops.pallas_gates) with
-        two-frame scheduling: one HBM pass per run instead of one GEMM pass
-        per dense block. ``shard_devices`` plans for execution on a register
+        ``pallas=True`` additionally routes gate runs through the fused
+        Pallas kernel (ops.pallas_gates) with two-frame scheduling: one HBM
+        pass per run instead of one GEMM pass per dense block. Density
+        tapes plan over the flattened 2n-qubit state with explicit
+        conj-shadow ops (fusion._shadow_pop). ``shard_devices`` plans for execution on a register
         sharded over that many devices: the tile limit shrinks to the
         shard-local size so every emitted run is per-shard executable under
         shard_map (fusion._shard_map_pallas_run); Circuit.run keeps that
@@ -212,9 +213,12 @@ class Circuit:
         from .precision import real_dtype
 
         tile_bits = None
-        if pallas and not self.is_density_matrix:
+        if pallas:
             from .ops.pallas_gates import LANE_BITS, local_qubits
-            n_eff = self.num_qubits
+            # density tapes plan over the flattened 2n-qubit state: the
+            # conj-shadow column qubits are explicit ops in the plan
+            # (fusion._shadow_pop), so the tile geometry is the state's
+            n_eff = (2 if self.is_density_matrix else 1) * self.num_qubits
             if shard_devices and shard_devices > 1:
                 d = int(shard_devices)
                 if d & (d - 1):
@@ -228,7 +232,8 @@ class Circuit:
                 tile_bits = local_qubits(n_eff)
         p = fusion.plan(tuple(self._tape), self.num_qubits,
                         np.dtype(dtype) if dtype else real_dtype(),
-                        max_qubits=max_qubits, pallas_tile_bits=tile_bits)
+                        max_qubits=max_qubits, pallas_tile_bits=tile_bits,
+                        is_density=self.is_density_matrix)
         out = Circuit(self.num_qubits, self.is_density_matrix)
         out._tape = fusion.as_tape(p)
         return out

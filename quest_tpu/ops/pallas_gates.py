@@ -97,16 +97,26 @@ def _grid_bit(q: int, tile_bits: int):
 
 
 def _partner(arr, q: int):
-    """arr[i ^ 2^q] within the tile via two circular rolls + per-bit select."""
+    """arr[i ^ 2^q] within the tile.
+
+    Lane bits (q < 7) use two circular rolls + per-bit select (intra-lane
+    shuffles, ~free). Sublane bits use a reshape/slice half-exchange
+    instead: splitting the sublane axis at the target bit and swapping the
+    halves is a pure sub-array copy -- measured ~0.05-0.2 ms per gate at
+    2^26 amps vs ~8 ms for the same butterfly as sublane pltpu.rolls
+    (Mosaic lowers cross-sublane rolls to very slow shuffle sequences;
+    round-3 microbench, the single biggest kernel cost discovered)."""
     if q < LANE_BITS:
-        m, axis = 1 << q, 1
-    else:
-        m, axis = 1 << (q - LANE_BITS), 0
-    size = arr.shape[axis]
-    up = pltpu.roll(arr, size - m, axis)   # up[i] = arr[i + m] (shift >= 0 req)
-    dn = pltpu.roll(arr, m, axis)          # dn[i] = arr[i - m]
-    bit = _bit_mask(q, arr.shape)
-    return jnp.where(bit == 0, up, dn)
+        m = 1 << q
+        size = arr.shape[1]
+        up = pltpu.roll(arr, size - m, 1)  # up[i] = arr[i + m] (shift >= 0)
+        dn = pltpu.roll(arr, m, 1)         # dn[i] = arr[i - m]
+        bit = _bit_mask(q, arr.shape)
+        return jnp.where(bit == 0, up, dn)
+    m = 1 << (q - LANE_BITS)
+    s, lanes = arr.shape
+    v = arr.reshape(s // (2 * m), 2, m, lanes)
+    return jnp.stack([v[:, 1], v[:, 0]], axis=1).reshape(s, lanes)
 
 
 def _ctrl_scalar_and_mask(controls, states, tile_bits, shape, gbit):
@@ -170,6 +180,33 @@ def _op_is_diag(op):
     return False
 
 
+#: estimated per-op kernel cost in ms at 2^26 amps f32 (round-3 microbench,
+#: after the slice-butterfly rewrite of _partner). Only the RATIOS matter:
+#: the fold decision compares accumulated butterfly cost against the zone's
+#: dense-dot cost on the same scale.
+_FOLD_LANE_DOT_MS = 2.9     # lane_u: (S,256)@(256,256) HIGHEST per tile
+_FOLD_WINDOW_DOT_MS = 1.0   # sublane window: per-slab (2D,2D) dots
+
+
+def _op_cost_ms(op) -> float:
+    """Estimated in-kernel cost of one un-folded op (see table above):
+    diagonals are ~free; lane butterflies and m>=8 sublane slice
+    butterflies are cheap; small-m sublane butterflies (q=7,8,9) pay
+    sub-sublane-tile relayouts."""
+    if _op_is_diag(op):
+        return 0.02
+    def tcost(q):
+        if q < LANE_BITS:
+            return 0.1
+        m = q - LANE_BITS
+        return (1.3, 0.45, 0.25)[m] if m < 3 else 0.08
+    if op[0] == "matrix":
+        return tcost(op[1])
+    if op[0] == "swap":
+        return tcost(op[1]) + tcost(op[2])
+    return 0.02
+
+
 def _fold_zone_ops(ops, tile_bits: int) -> tuple:
     """Contract runs of zone-local ops into dense per-zone matrices.
 
@@ -185,11 +222,12 @@ def _fold_zone_ops(ops, tile_bits: int) -> tuple:
       sublane zone-> ("window", lo, span, W_2Dx2D)  per-A W @ y dots (MXU)
 
     This is the dense-fusion economics of quest_tpu/fusion.py applied
-    inside the kernel: the round-2 profile showed per-gate sublane
-    butterflies cost ~0.4 ms each (VPU) while a whole folded zone costs
-    about one ms-scale MXU pass (BASELINE.md round-2 table). Accumulators
-    holding fewer than 2 non-diagonal ops emit their originals (a butterfly
-    is cheaper than a dot for a single gate)."""
+    inside the kernel, with a COST MODEL deciding each flush: a zone folds
+    only when the estimated cost of its accumulated butterflies
+    (_op_cost_ms) exceeds the zone's dense-dot cost. After the round-3
+    slice-butterfly rewrite most butterflies are nearly free, so folding
+    pays mainly in the [7,12) zone (whose q=7..9 butterflies pay
+    sub-sublane-tile relayouts) and for long lane runs."""
     from ..fusion import event_matrix
 
     zones = [(0, LANE_BITS)]
@@ -212,12 +250,8 @@ def _fold_zone_ops(ops, tile_bits: int) -> tuple:
         run = accum[z]
         if not run:
             return
-        # threshold tuned on the 26q bench: folding zones holding a single
-        # partner-exchange gate measured SLOWER end-to-end (2268 vs 2604
-        # gates/s) -- inside a long run an extra 64x64 zone dot costs more
-        # than one amortised butterfly -- so a zone folds only once it holds
-        # >=2 non-diagonal gates
-        if sum(not _op_is_diag(o) for o in run) < 2:
+        dot_ms = _FOLD_LANE_DOT_MS if z[0] == 0 else _FOLD_WINDOW_DOT_MS
+        if sum(_op_cost_ms(o) for o in run) <= dot_ms:
             out.extend(run)
             run.clear()
             return

@@ -63,18 +63,83 @@ def test_load_rejects_corrupt_metadata(tmp_path):
     qt.initPlusState(q)
     ckpt = str(tmp_path / "ck")
     qt.saveQureg(q, ckpt)
-    # truncate the amplitude payload
-    np.savez_compressed(os.path.join(ckpt, "amps.npz"),
-                        amps=np.zeros((2, 4), np.float32))
+    shard_files = [f for f in os.listdir(ckpt) if f.startswith("amps.shard_")]
+    assert shard_files
+    # wrong-shaped shard payload
+    np.savez_compressed(os.path.join(ckpt, shard_files[0]),
+                        amps=np.zeros((2, 4), np.float32),
+                        start=np.int64(0), stop=np.int64(4))
     with pytest.raises(QuESTError):
         qt.loadQureg(ckpt, ENV)
     with pytest.raises(QuESTError):
         qt.loadQureg(str(tmp_path / "nowhere"), ENV)
     # truncated payload (crash mid-write) must raise QuESTError, not escape
-    with open(os.path.join(ckpt, "amps.npz"), "wb") as f:
+    with open(os.path.join(ckpt, shard_files[0]), "wb") as f:
         f.write(b"PK\x03\x04 truncated")
     with pytest.raises(QuESTError):
         qt.loadQureg(ckpt, ENV)
+
+
+def test_sharded_save_writes_per_shard_files_without_gather(tmp_path):
+    """VERDICT r2 next #5: saveQureg of a sharded register writes one file
+    per device shard and never gathers the state (process_allgather is
+    poisoned for the duration; the shard files jointly hold each amplitude
+    exactly once)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    env = qt.createQuESTEnv(jax.devices()[:8])
+    q = qt.createQureg(10, env)
+    qt.initDebugState(q)
+    before = np.asarray(q.amps).copy()
+    assert len(q.amps.sharding.device_set) == 8
+
+    from jax.experimental import multihost_utils
+
+    def poisoned(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("sharded save must not gather")
+
+    saved = multihost_utils.process_allgather
+    multihost_utils.process_allgather = poisoned
+    try:
+        ckpt = str(tmp_path / "ck8")
+        qt.saveQureg(q, ckpt)
+    finally:
+        multihost_utils.process_allgather = saved
+
+    shard_files = sorted(f for f in os.listdir(ckpt)
+                         if f.startswith("amps.shard_"))
+    assert len(shard_files) == 8
+    total = 0
+    for f in shard_files:
+        with np.load(os.path.join(ckpt, f)) as z:
+            total += z["amps"].shape[1]
+            assert z["amps"].shape[1] == int(z["stop"]) - int(z["start"])
+    assert total == q.num_amps_total
+
+    # round-trip onto the same mesh, a smaller mesh, and a single device
+    for devs in (jax.devices()[:8], jax.devices()[:4], jax.devices()[:1]):
+        env2 = qt.createQuESTEnv(devs)
+        q2 = qt.loadQureg(ckpt, env2)
+        np.testing.assert_allclose(np.asarray(q2.amps), before, atol=0)
+
+
+def test_unsharded_save_from_sharded_snapshot(tmp_path):
+    """A single-device register saved with the sharded writer loads onto a
+    sharded env (1 shard file covering everything, re-split on load)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the multi-device CPU mesh")
+    q = qt.createQureg(9, ENV)
+    qt.initDebugState(q)
+    ckpt = str(tmp_path / "ck1")
+    qt.saveQureg(q, ckpt)
+    env8 = qt.createQuESTEnv(jax.devices()[:4])
+    q2 = qt.loadQureg(ckpt, env8)
+    assert len(q2.amps.sharding.device_set) == 4
+    np.testing.assert_allclose(np.asarray(q2.amps), np.asarray(q.amps), atol=0)
 
 
 def test_write_state_csv_matches_reference_format(tmp_path):

@@ -75,12 +75,61 @@ def test_pallas_integrated_fusion_agrees(seed):
                                np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
 
 
-def test_density_tapes_never_use_pallas():
-    circ = Circuit(4, is_density_matrix=True)
+def test_density_tapes_ride_pallas_with_shadow_ops():
+    """Round-3 density fast path: a density tape plans PallasRuns whose
+    ops include the explicit conj-shadow twins on (q + n), and the replay
+    matches the eager engine (which derives shadows itself)."""
+    n = 5  # flattened state: 10 qubits
+    circ = Circuit(n, is_density_matrix=True)
     circ.hadamard(0)
     circ.controlledNot(0, 1)
+    circ.rotateZ(2, 0.4)
+    circ.tGate(4)
     fz = circ.fused(max_qubits=3, pallas=True)
-    assert all(f.__name__ != "_apply_pallas_run" for f, _, _ in fz._tape)
+    runs = [a[0] for f, a, _ in fz._tape if f.__name__ == "_apply_pallas_run"]
+    assert runs, "density tape produced no PallasRuns"
+    targets = {op[1] for ops in runs for op in ops if op[0] == "matrix"}
+    assert any(t >= n for t in targets), "no shadow ops in the plan"
+
+    env = qt.createQuESTEnv()
+    rho = qt.createDensityQureg(n, env)
+    qt.initPlusState(rho)
+    ref = qt.createDensityQureg(n, env)
+    qt.initPlusState(ref)
+    fz.run(rho)
+    for f, a, kw in circ._tape:
+        f(ref, *a, **kw)
+    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
+                               atol=TOL, rtol=TOL)
+
+
+def test_density_pallas_with_frame_swaps_matches_oracle():
+    """Density planning where column qubits exceed the tile: shadow ops on
+    grid bits force frame swaps; amplitudes must match the eager engine."""
+    from __graft_entry__ import _random_layers
+
+    n = 6  # flattened: 12 qubits
+    circ = Circuit(n, is_density_matrix=True)
+    _random_layers(circ, n, depth=2, seed=7)
+    p = fusion.plan(tuple(circ._tape), n, real_dtype(), max_qubits=4,
+                    pallas_tile_bits=PG.local_qubits(12, sublanes=4),
+                    is_density=True)
+    fz = Circuit(n, is_density_matrix=True)
+    fz._tape = fusion.as_tape(p)
+    anns = [(a[2], a[3]) for f, a, _ in fz._tape
+            if f.__name__ == "_apply_pallas_run"]
+    assert any(lk or sk for lk, sk in anns), "no frame swaps planned"
+
+    env = qt.createQuESTEnv()
+    rho = qt.createDensityQureg(n, env)
+    qt.initPlusState(rho)
+    ref = qt.createDensityQureg(n, env)
+    qt.initPlusState(ref)
+    fz.run(rho)
+    for f, a, kw in circ._tape:
+        f(ref, *a, **kw)
+    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
+                               atol=TOL, rtol=TOL)
 
 
 def test_plan_reframes_high_qubit_dense_gates():

@@ -53,7 +53,55 @@ static long apply_layers(Qureg q, int n, int depth) {
     return gates;
 }
 
+/* The density-channel anchor: the same circuit as bench.py's
+ * bench_density (4x H + 2x CNOT + 2x mixDepolarising + mixKrausMap +
+ * mixTwoQubitDephasing = 10 channel ops per rep), timed through the
+ * reference's own density kernels (densmatr_mixDepolarisingLocal,
+ * QuEST_cpu.c:137-185; mixKrausMap via the 2t-qubit superoperator,
+ * QuEST_common.c:581-638). */
+static long apply_density_step(Qureg rho, int n) {
+    qreal k = 0.70710678118654752440;
+    ComplexMatrix2 kraus[2] = {
+        {.real = {{k, 0}, {0, k}}, .imag = {{0, 0}, {0, 0}}},
+        {.real = {{0, k}, {k, 0}}, .imag = {{0, 0}, {0, 0}}},
+    };
+    for (int t = 0; t < 4; t++) hadamard(rho, t);
+    controlledNot(rho, 0, 1);
+    controlledNot(rho, 2, 3);
+    mixDepolarising(rho, 0, 0.05);
+    mixDepolarising(rho, n - 1, 0.05);
+    mixKrausMap(rho, 1, kraus, 2);
+    mixTwoQubitDephasing(rho, 0, 1, 0.1);
+    return 10;
+}
+
+static int main_density(int n, int reps) {
+    QuESTEnv env = createQuESTEnv();
+    Qureg rho = createDensityQureg(n, env);
+    initPlusState(rho);
+
+    long ops = apply_density_step(rho, n); /* warm caches */
+    double t0 = now_sec();
+    long total = 0;
+    for (int r = 0; r < reps; r++)
+        total += apply_density_step(rho, n);
+    double dt = now_sec() - t0;
+
+    printf("{\"qubits\": %d, \"density\": true, \"channel_ops\": %ld, "
+           "\"reps\": %d, \"channel_ops_per_sec\": %.2f}\n",
+           n, ops, reps, total / dt);
+    destroyQureg(rho, env);
+    destroyQuESTEnv(env);
+    return 0;
+}
+
 int main(int argc, char **argv) {
+    if (argc > 1 && argv[1][0] == '-' && argv[1][1] == '-'
+            && argv[1][2] == 'd') { /* --density [n] [reps] */
+        int n = argc > 2 ? atoi(argv[2]) : 14;
+        int reps = argc > 3 ? atoi(argv[3]) : 3;
+        return main_density(n, reps);
+    }
     int n = argc > 1 ? atoi(argv[1]) : 20;
     int depth = argc > 2 ? atoi(argv[2]) : 8;
     int reps = argc > 3 ? atoi(argv[3]) : 3;
