@@ -83,7 +83,12 @@ def saveQureg(qureg: Qureg, directory: str) -> None:
         # name shards by their global start offset: unique across processes
         # without coordination (shards partition the amp axis)
         fname = f"amps.shard_{start:016x}.npz"
-        tmp = os.path.join(directory, fname + ".tmp")
+        # process-unique tmp name: replicated layouts have several processes
+        # writing the same range to the same final name; the atomic replace
+        # makes the duplicate writes idempotent, but a shared tmp path would
+        # tear mid-write
+        tmp = os.path.join(directory,
+                           f"{fname}.{jax.process_index()}.tmp")
         with open(tmp, "wb") as f:
             np.savez_compressed(f, amps=np.asarray(data),
                                 start=np.int64(start), stop=np.int64(stop))

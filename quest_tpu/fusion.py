@@ -45,7 +45,12 @@ from . import precision
 class GateEvent:
     """One primitive application captured from a tape entry.
 
-    kind: 'matrix' | 'diag' | 'x' | 'parity' | 'swap'
+    kind: 'matrix' | 'diag' | 'x' | 'parity' | 'swap' | 'channel'
+
+    'channel' events (Kraus maps on density registers) and events with
+    ``extended=True`` carry targets in FLATTENED-state coordinates
+    (column qubits at q + n already explicit) and take no conj-shadow
+    twin during density planning.
     """
     kind: str
     targets: tuple
@@ -54,6 +59,8 @@ class GateEvent:
     matrix: Optional[np.ndarray] = None   # 'matrix': (2^t, 2^t) complex
     diag: Optional[np.ndarray] = None     # 'diag':   (2^t,) complex
     theta: float = 0.0                    # 'parity'
+    superop: Optional[np.ndarray] = None  # 'channel': (4^t, 4^t) complex
+    extended: bool = False                # targets already in 2n coords
 
     @property
     def support(self) -> frozenset:
@@ -92,6 +99,34 @@ class _SpyQureg:
 
     def put(self, amps):  # swapGate's inline path calls this with the token
         self.amps = amps
+
+
+@contextlib.contextmanager
+def _channel_capture_ctx(events: list):
+    """Patch the density-channel appliers in :mod:`.ops.density` to record
+    events: Kraus channels (via apply_channel) and dephasing diagonals (via
+    _diag_dispatch) -- both in flattened 2n coordinates."""
+    from .ops import density as DN
+
+    def cap_channel(amps, superop, *, n, targets):
+        events.append(GateEvent(
+            "channel", tuple(targets),
+            superop=np.asarray(superop, dtype=complex), extended=True))
+        return amps
+
+    def cap_dens_diag(amps, d, *, n, targets):
+        dc = np.asarray(d[0]) + 1j * np.asarray(d[1])
+        events.append(GateEvent("diag", tuple(targets), diag=dc,
+                                extended=True))
+        return amps
+
+    saved = (DN.apply_channel, DN._diag_dispatch)
+    DN.apply_channel = cap_channel
+    DN._diag_dispatch = cap_dens_diag
+    try:
+        yield
+    finally:
+        DN.apply_channel, DN._diag_dispatch = saved
 
 
 @contextlib.contextmanager
@@ -135,20 +170,35 @@ def _capture_ctx(events: list):
          G._apply_gate_parity_phase, K.apply_swap) = saved
 
 
-def capture(fn, args, kwargs, num_qubits: int, dtype) -> Optional[list]:
+def capture(fn, args, kwargs, num_qubits: int, dtype,
+            is_density: bool = False) -> Optional[list]:
     """Replay one tape entry against a spy register; return its GateEvents,
-    or None if the entry doesn't route through the gate primitives (it then
-    acts as a fusion barrier and runs on the device path unchanged).
+    or None if the entry doesn't route through the capturable primitives
+    (it then acts as a fusion barrier and runs on the device path
+    unchanged).
 
-    The spy is always a state-vector register: gate functions with inline
-    density branches (swapGate) would otherwise record their shadow op too,
-    and the shadow is re-derived at emission by the real primitives.
-    Density-only entries (decoherence) fail validation and become barriers.
+    The first attempt always uses a STATE-VECTOR spy: gate functions with
+    inline density branches (swapGate) would otherwise record their shadow
+    op too, and shadows are derived at planning/emission instead. Entries
+    that fail that attempt on a density tape (decoherence channels, whose
+    validation demands a density register) get a second attempt against a
+    density spy with the channel appliers patched -- their events carry
+    flattened-state coordinates and ``extended=True``.
     """
     events: list = []
     shell = _SpyQureg(num_qubits, False, dtype)
     try:
         with _capture_ctx(events):
+            fn(shell, *args, **kwargs)
+        return events if events else None
+    except Exception:
+        pass
+    if not is_density:
+        return None
+    events = []
+    shell = _SpyQureg(num_qubits, True, dtype)
+    try:
+        with _capture_ctx(events), _channel_capture_ctx(events):
             fn(shell, *args, **kwargs)
     except Exception:
         return None
@@ -463,6 +513,8 @@ class _FramePlanner:
             return ("matrix", t[0], c, op.states, HashableMatrix(op.data))
         if op.kind == "swap":
             return ("swap", t[0], t[1], c, op.states)
+        if op.kind == "kraus1":
+            return ("kraus1", t[0], t[1], op.data)
         if op.kind == "diagw":
             return ("diagw", t, c, HashableMatrix(op.data))
         return ("parity", t, c, op.data)
@@ -610,6 +662,22 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
     return out
 
 
+def _lower_channel(ev: GateEvent, n: int):
+    """'channel' event -> [_POp('kraus1', (t, t+n), ...)] for single-target
+    Kraus maps, or None (multi-target channels stay barriers and run the
+    engine/fused-Kraus path). The op's data is the hashable Kraus-term
+    tuple ((sign, K2x2), ...) from the superoperator's Choi decomposition."""
+    from .ops.density import choi_kraus
+    from .ops.pallas_gates import HashableMatrix
+
+    if len(ev.targets) != 1:
+        return None
+    t = ev.targets[0]
+    terms = tuple((float(s), HashableMatrix(k))
+                  for s, k in choi_kraus(ev.superop))
+    return [_POp("kraus1", (t, t + n), (), (), terms, False)]
+
+
 def _shadow_pop(op: _POp, n: int) -> _POp:
     """The density conj-shadow twin of a lowered row op: same op on the
     column qubits (q + n) with conjugated data (QuEST.c:184-193). Parity
@@ -641,22 +709,33 @@ def _plan_pallas(tape, num_qubits: int, dtype, max_qubits: int,
     sched = _FramePlanner(out, tile_bits, k)
 
     for fn, args, kwargs in tape:
-        events = capture(fn, args, kwargs, num_qubits, dtype)
+        events = capture(fn, args, kwargs, num_qubits, dtype,
+                         is_density=is_density)
         lowered = None
         if events is not None:
-            lowered = [_lower_event(ev) for ev in events]
-            if is_density:
-                lowered = [None if pops is None else
-                           [q for p in pops
-                            for q in (p, _shadow_pop(p, num_qubits))]
-                           for pops in lowered]
-            ok = all(
-                (pops is not None
-                 and all(sched.feasible_somewhere(p) for p in pops))
-                or len(_window(ev.support)) <= max_qubits
-                for ev, pops in zip(events, lowered))
-            if not ok:
-                events = None  # too wide for any route: run the entry as-is
+            lowered = []
+            for ev in events:
+                if ev.kind == "channel":
+                    pops = _lower_channel(ev, num_qubits)
+                else:
+                    pops = _lower_event(ev)
+                    if pops is not None and is_density and not ev.extended:
+                        pops = [q for p in pops
+                                for q in (p, _shadow_pop(p, num_qubits))]
+                lowered.append(pops)
+
+            def routable(ev, pops):
+                if (pops is not None
+                        and all(sched.feasible_somewhere(p) for p in pops)):
+                    return True
+                # dense window fallback -- unitary events only (a channel
+                # has no dense 2^w x 2^w unitary to fall back to)
+                return (ev.kind != "channel"
+                        and len(_window(ev.support)) <= max_qubits)
+
+            if not all(routable(ev, pops)
+                       for ev, pops in zip(events, lowered)):
+                events = None  # no route for some event: run the entry as-is
         if events is None:
             sched.flush()
             out.items.append((fn, args, kwargs))
@@ -838,7 +917,7 @@ def _run_pallas_sharded(qureg, ops: tuple, mesh):
             diag = complex(m[0][1]) == 0 and complex(m[1][0]) == 0
             if not diag and op[1] >= lq:
                 return None
-        elif op[0] == "swap" and (op[1] >= lq or op[2] >= lq):
+        elif op[0] in ("swap", "kraus1") and (op[1] >= lq or op[2] >= lq):
             return None
 
     def body(x):
@@ -889,6 +968,18 @@ def _apply_ops_via_engine(qureg, ops: tuple) -> None:
                 raise ValueError("swap with 0-controls has no engine route")
             qureg.put(K.apply_swap(qureg.amps, n=nsv, qb1=q1, qb2=q2,
                                    controls=controls))
+        elif op[0] == "kraus1":
+            from .ops.density import _acc_kraus_term
+
+            _, t, c, terms = op
+            amps0 = qureg.amps
+            out = None
+            for sign, kk in terms:
+                km = cplx.from_complex(np.asarray(kk.arr), qureg.dtype)
+                y = apply_m(amps0 + 0, km, n=nsv, targets=(t,))
+                y = apply_m(y, km, n=nsv, targets=(c,), conj=True)
+                out = _acc_kraus_term(out, sign, y)
+            qureg.put(out)
         else:  # pragma: no cover
             raise ValueError(f"unknown pallas op {op[0]!r}")
 

@@ -117,19 +117,20 @@ def apply_channel(amps, superop, *, n: int, targets: tuple[int, ...]):
 
 
 def _kraus_sum_pallas(amps, terms, n, t, lq=None):
-    """Single-target Kraus sum with each term as ONE fused Pallas pass
-    (K on the row qubit + conj(K) on the column qubit in the same HBM
-    read+write), or None when the path doesn't apply (multi-device,
-    non-TPU without interpret, sub-tile state).
+    """Single-target Kraus sum as ONE fused Pallas pass: the whole channel
+    (every term's K on the row qubit + conj(K) on the column qubit, with
+    the signed accumulation) runs in-register per tile via the 'kraus1'
+    kernel op -- one HBM read+write total. Returns None when the path
+    doesn't apply (multi-device, row qubit above the tile, sub-tile state).
 
     The column qubit t+n usually sits above the tile (the density state
-    has 2n qubits); it is then relocated into the tile by a single-bit
-    block-swap transpose with a free in-tile qubit, the channel applied
-    there, and swapped back -- the single-chip analogue of the reference's
-    half-chunk density exchanges (QuEST_cpu_distributed.c:535-868), and
-    the same relocation idea as the two-frame planner, one qubit at a
-    time. Cost: #terms + (0 or 2) passes vs the engine path's 2 x #terms
-    window GEMMs. ``lq`` overrides the tile limit for tests."""
+    has 2n qubits); its relocation to the top in-tile slot is then FOLDED
+    into the pass's load/store DMA (fused_local_run's load_swap_hi) --
+    the free generalisation of the reference's half-chunk density
+    exchanges (QuEST_cpu_distributed.c:535-868), which pay dedicated
+    pack/exchange/unpack passes. Round 2 paid ~2 passes per Kraus term
+    plus 2 relocation transposes; this is one pass, always. ``lq``
+    overrides the tile limit for tests."""
     import jax
 
     from .. import fusion as _fusion
@@ -147,21 +148,21 @@ def _kraus_sum_pallas(amps, terms, n, t, lq=None):
     if lq is None:
         lq = PG.local_qubits(nsv)
     c = t + n
+    hi = None
+    if c >= lq:
+        # fold the 1-bit relocation [lq-1, lq) <-> [c, c+1) into the DMA;
+        # it would displace a row qubit sitting at lq-1 (impossible for
+        # single-chip sizes, but guard anyway)
+        if t >= lq - 1:
+            return None
+        hi = c
+        c = lq - 1
     if t >= lq:
         return None  # row qubit itself above the tile: engine path
-    swap = None
-    if c >= lq:
-        # free in-tile relocation slot, >= LANE_BITS so the block-swap
-        # transpose keeps a wide contiguous inner dimension
-        slot = next((q for q in range(lq - 1, PG.LANE_BITS - 1, -1)
-                     if q != t), None)
-        if slot is None:
-            return None
-        swap = (slot, c)
-        c = slot
     terms_h = tuple((float(s), PG.HashableMatrix(k)) for s, k in terms)
-    return _kraus_sum_pallas_run(amps + 0, n=n, t=t, c=c, swap=swap,
-                                 terms=terms_h)
+    return _kraus_sum_pallas_run(amps + 0, n=n, t=t, c=c, hi=hi,
+                                 terms=terms_h,
+                                 sublanes=1 << (lq - PG.LANE_BITS))
 
 
 def _acc_kraus_term(out, sign, term):
@@ -170,27 +171,20 @@ def _acc_kraus_term(out, sign, term):
     return term if out is None else out + term
 
 
-@partial(jax.jit, static_argnames=("n", "t", "c", "swap", "terms"),
+@partial(jax.jit, static_argnames=("n", "t", "c", "hi", "terms", "sublanes"),
          donate_argnums=(0,))
-def _kraus_sum_pallas_run(amps, *, n, t, c, swap, terms):
-    """One compiled program for the whole fused-Kraus channel: optional
-    relocation swap, every per-term kernel pass, the signed accumulation,
-    and the swap back -- XLA elides the intermediate copies and the caller
-    pays one dispatch instead of ~3 per term."""
+def _kraus_sum_pallas_run(amps, *, n, t, c, hi, terms, sublanes):
+    """The whole fused-Kraus channel as one kernel pass (see
+    _kraus_sum_pallas); ``hi`` is the grid-bit column position relocated
+    into the top tile slot by the folded load/store swaps. ``sublanes``
+    pins the tile geometry to the ``lq`` the caller planned against."""
     from . import pallas_gates as PG
 
-    nsv = 2 * n
-    if swap is not None:
-        amps = PG.swap_bit_blocks(amps, n=nsv, lo1=swap[0], lo2=swap[1], k=1)
-    out = None
-    for sign, k in terms:
-        ops = (("matrix", t, (), (), k),
-               ("matrix", c, (), (), PG.HashableMatrix(np.conj(k.arr))))
-        out = _acc_kraus_term(out, sign,
-                              PG.fused_local_run(amps + 0, n=nsv, ops=ops))
-    if swap is not None:
-        out = PG.swap_bit_blocks(out, n=nsv, lo1=swap[0], lo2=swap[1], k=1)
-    return out
+    k = 0 if hi is None else 1
+    return PG.fused_local_run(
+        amps, n=2 * n, ops=(("kraus1", t, c, terms),), sublanes=sublanes,
+        load_swap_k=k, load_swap_hi=hi,
+        store_swap_k=k, store_swap_hi=hi)
 
 
 @partial(jax.jit, static_argnames=("n", "targets", "signs"), donate_argnums=(0,))

@@ -164,8 +164,8 @@ def _op_event(op):
 def _op_support(op):
     if op[0] == "matrix":
         return {op[1], *op[2]}
-    if op[0] == "swap":
-        return {op[1], op[2], *op[3]}
+    if op[0] in ("swap", "kraus1"):
+        return {op[1], op[2], *(op[3] if op[0] == "swap" else ())}
     if op[0] in ("diagw", "parity"):
         return {*op[1], *op[2]}
     return set(range(LANE_BITS))  # lane_u acts on the lane zone
@@ -204,6 +204,7 @@ def _op_cost_ms(op) -> float:
         return tcost(op[1])
     if op[0] == "swap":
         return tcost(op[1]) + tcost(op[2])
+    # kraus1 never reaches this model: zone_of() bars it from accumulators
     return 0.02
 
 
@@ -240,6 +241,8 @@ def _fold_zone_ops(ops, tile_bits: int) -> tuple:
     accum = {z: [] for z in zones}   # zone -> [op]
 
     def zone_of(op):
+        if op[0] == "kraus1":
+            return None  # non-unitary: must never enter a zone's dense fold
         s = _op_support(op)
         for z in zones:
             if all(z[0] <= q < z[1] for q in s):
@@ -319,18 +322,41 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
     """
     one = np.array(1, dtype)
 
+    def mat2(xr, xi, q, M):
+        """Uncontrolled 2x2 on in-tile qubit q (the core of the 'matrix'
+        op, reused per-term by the kraus ops); returns new (xr, xi)."""
+        shape = xr.shape
+        m00, m01, m10, m11 = (complex(M[0, 0]), complex(M[0, 1]),
+                              complex(M[1, 0]), complex(M[1, 1]))
+        bit = _bit_mask(q, shape)
+        if m01 == 0 and m10 == 0:
+            dr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
+            di = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
+            return (dr * xr - di * xi, dr * xi + di * xr)
+        pr = _partner(xr, q)
+        pi = _partner(xi, q)
+        csr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
+        cpr = jnp.where(bit == 0, dtype.type(m01.real), dtype.type(m10.real))
+        if (m00.imag == 0 and m01.imag == 0 and
+                m10.imag == 0 and m11.imag == 0):
+            return (csr * xr + cpr * pr, csr * xi + cpr * pi)
+        csi = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
+        cpi = jnp.where(bit == 0, dtype.type(m01.imag), dtype.type(m10.imag))
+        return (csr * xr - csi * xi + cpr * pr - cpi * pi,
+                csr * xi + csi * xr + cpr * pi + cpi * pr)
+
     def kernel(x_ref, hi_ref, *refs):
         w_refs = refs[:-1]
         o_ref = refs[-1]
         if load_swap is not None:
-            # (2, 1, dk, 1, s_low, 128) block: axis 2 is the (old) grid-bit
-            # block, already sitting where the new frame's high sublane bits
-            # belong -- collapsing (dk, s_low) into the sublane axis IS the
-            # frame swap, and is layout-free when s_low fills >= 1 sublane
-            # tile (the planner guarantees s_low >= 8)
+            # (2, 1, dk, 1, 1, s_low, 128) block: axis 2 is the (old)
+            # grid-bit block, already sitting where the new frame's high
+            # sublane bits belong -- collapsing (dk, s_low) into the sublane
+            # axis IS the bit-block swap, and is layout-free when s_low
+            # fills >= 1 sublane tile (the callers guarantee s_low >= 8)
             dk, s_low = load_swap
-            xr = x_ref[0, 0, :, 0].reshape(dk * s_low, _LANES)
-            xi = x_ref[1, 0, :, 0].reshape(dk * s_low, _LANES)
+            xr = x_ref[0, 0, :, 0, 0].reshape(dk * s_low, _LANES)
+            xi = x_ref[1, 0, :, 0, 0].reshape(dk * s_low, _LANES)
         else:
             xr = x_ref[0]
             xi = x_ref[1]
@@ -455,6 +481,27 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
                 xr = xr + sel * (p2r - xr)
                 xi = xi + sel * (p2i - xi)
 
+            elif op[0] == "kraus1":
+                # whole single-target channel in ONE pass: for each Kraus
+                # term apply K on the row qubit and conj(K) on the column
+                # qubit to a COPY of the registers, accumulate sign-weighted
+                # -- rho' = sum_k s_k K_k rho K_k^dagger with zero extra HBM
+                # traffic (the reference pays a dedicated kernel launch per
+                # channel, QuEST_gpu.cu:2423-2600; the round-2 build paid
+                # ~2 passes per term)
+                _, t, c, terms = op
+                acc_r = acc_i = None
+                for sign, K in terms:
+                    K = np.asarray(K.arr if hasattr(K, "arr") else K)
+                    yr, yi = mat2(xr, xi, t, K)
+                    yr, yi = mat2(yr, yi, c, np.conj(K))
+                    if sign != 1.0:
+                        yr = dtype.type(sign) * yr
+                        yi = dtype.type(sign) * yi
+                    acc_r = yr if acc_r is None else acc_r + yr
+                    acc_i = yi if acc_i is None else acc_i + yi
+                xr, xi = acc_r, acc_i
+
             elif op[0] == "diagw":
                 _, targets, controls, D = op
                 d = np.asarray(D.arr if hasattr(D, "arr") else D).reshape(-1)
@@ -482,8 +529,8 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
 
         if store_swap is not None:
             dk, s_low = store_swap
-            o_ref[0, 0, :, 0] = xr.reshape(dk, s_low, _LANES)
-            o_ref[1, 0, :, 0] = xi.reshape(dk, s_low, _LANES)
+            o_ref[0, 0, :, 0, 0] = xr.reshape(dk, s_low, _LANES)
+            o_ref[1, 0, :, 0, 0] = xi.reshape(dk, s_low, _LANES)
         else:
             o_ref[0] = xr
             o_ref[1] = xi
@@ -493,7 +540,9 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
 
 def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
                     interpret: bool | None = None, shard_index=None,
-                    load_swap_k: int = 0, store_swap_k: int = 0):
+                    load_swap_k: int = 0, store_swap_k: int = 0,
+                    load_swap_hi: int | None = None,
+                    store_swap_hi: int | None = None):
     """Apply ``ops`` (see module doc) to the planar (2, 2^n) state in one
     fused Pallas pass. Every matrix target must satisfy
     ``q < local_qubits(n, sublanes)``; parity members and controls may be
@@ -505,12 +554,16 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
     shard with ``n`` LOCAL qubits, and op roles on qubits >= n (sharded
     qubits of the global register) resolve against the shard index.
 
-    ``load_swap_k`` = k > 0 folds ``swap_bit_blocks(lo1=tb-k, lo2=tb, k)``
-    (tb = the tile-bit count of this call's geometry) into the input DMA:
-    the state arrives in the OTHER frame and is relabeled during load, so
-    ``ops`` must already be in this run's frame. ``store_swap_k`` folds the
-    same relabeling into the output DMA (the result lands in the other
-    frame). Either costs zero extra HBM passes. Incompatible with
+    ``load_swap_k`` = k > 0 folds ``swap_bit_blocks(lo1=tb-k, lo2, k)``
+    (tb = the tile-bit count of this call's geometry; lo2 =
+    ``load_swap_hi`` or tb) into the input DMA: the state arrives in the
+    OTHER frame and is relabeled during load, so ``ops`` must already be
+    in this run's frame. ``store_swap_k``/``store_swap_hi`` fold the same
+    relabeling into the output DMA (the result lands in the other frame).
+    Either costs zero extra HBM passes. A non-default ``*_hi`` relocates
+    an ARBITRARY grid-bit block into the top sublane slots -- the free
+    generalisation of the reference's swap-to-local relocation
+    (QuEST_cpu_distributed.c:1526-1568). Incompatible with
     ``shard_index`` (the exchanged grid bits are sharded there)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -531,8 +584,8 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
             raise ValueError(
                 f"non-diagonal matrix target {o[1]} >= local_qubits({n}, "
                 f"{sublanes}) = {lq}; route wide targets via ops.apply")
-        if o[0] == "swap" and (o[1] >= lq or o[2] >= lq):
-            raise ValueError(f"swap targets {o[1:3]} must be < {lq}")
+        if o[0] in ("swap", "kraus1") and (o[1] >= lq or o[2] >= lq):
+            raise ValueError(f"{o[0]} targets {o[1:3]} must be < {lq}")
     if shard_index is None:
         shard_index = jnp.zeros((1,), jnp.int32)
         local_n = None
@@ -543,44 +596,66 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
                             ops=_fold_zone_ops(ops, lq),
                             sublanes=sublanes, interpret=bool(interpret),
                             local_n=local_n, load_swap_k=int(load_swap_k),
-                            store_swap_k=int(store_swap_k))
+                            store_swap_k=int(store_swap_k),
+                            load_swap_hi=load_swap_hi,
+                            store_swap_hi=store_swap_hi)
 
 
-def _swap_view(x, grid: int, s: int, k: int):
-    """(2, rows, 128) -> the 6-D frame-swap view (2, ghi, dk, dk, s_low, 128)
-    whose middle axes are the k-bit grid block and the top-k sublane block."""
+def _swap_view(x, rows: int, s: int, lo2_rel: int, k: int):
+    """(2, rows, 128) -> the 7-D bit-block-swap view
+    (2, high, dg, gmid, ds, s_low, 128): ``dg`` is the k-bit grid block at
+    row bits [lo2_rel, lo2_rel+k), ``ds`` the top-k sublane block at
+    [s_bits-k, s_bits), ``gmid`` the grid bits between them. Exchanging dg
+    and ds relabels amplitudes exactly like swap_bit_blocks(tb-k, lo2, k)
+    -- lo2 may be ANY grid-bit offset, not just the tile boundary."""
+    s_bits = s.bit_length() - 1
     dk = 1 << k
-    return x.reshape(2, grid // dk, dk, dk, s >> k, _LANES)
+    gmid = 1 << (lo2_rel - s_bits)
+    high = rows // (dk * gmid * (s >> k) * dk)
+    return x.reshape(2, high, dk, gmid, dk, s >> k, _LANES)
 
 
-def _swap_spec(s: int, k: int):
-    """BlockSpec gathering/scattering one frame-permuted tile per program:
-    for (new-frame) grid index i, all dk positions of the old grid block at
-    old-sublane-block position i % dk -- dk strided (s_low, 128) row-chunks
-    whose concatenation IS the tile in the new frame."""
+def _swap_spec(s: int, lo2_rel: int, k: int):
+    """BlockSpec gathering/scattering one swap-permuted tile per program:
+    for new grid index i, all dk positions of the old grid block, at the
+    old-sublane-block position encoded in i's [lo2_rel - s_bits) bits --
+    dk strided (s_low, 128) row-chunks whose concatenation IS the tile in
+    the new frame."""
+    s_bits = s.bit_length() - 1
     dk = 1 << k
-    return pl.BlockSpec((2, 1, dk, 1, s >> k, _LANES),
-                        lambda i: (0, i // dk, 0, i % dk, 0, 0),
+    gm_sz = 1 << (lo2_rel - s_bits)
+
+    def imap(i):
+        gm = i % gm_sz
+        rest = i // gm_sz
+        return (0, rest // dk, 0, gm, rest % dk, 0, 0)
+
+    return pl.BlockSpec((2, 1, dk, 1, 1, s >> k, _LANES), imap,
                         memory_space=pltpu.VMEM)
 
 
 @partial(jax.jit, static_argnames=("n", "ops", "sublanes", "interpret",
-                                  "local_n", "load_swap_k", "store_swap_k"),
+                                  "local_n", "load_swap_k", "store_swap_k",
+                                  "load_swap_hi", "store_swap_hi"),
          donate_argnums=(0,))
 def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
                      interpret: bool, local_n: int | None,
-                     load_swap_k: int = 0, store_swap_k: int = 0):
+                     load_swap_k: int = 0, store_swap_k: int = 0,
+                     load_swap_hi: int | None = None,
+                     store_swap_hi: int | None = None):
     num = amps.shape[-1]
     rows = max(num >> LANE_BITS, 1)
     s = min(sublanes, rows)
     s_bits = int(math.log2(s)) if s > 1 else 0
     tile_bits = LANE_BITS + s_bits
     grid = rows // s
-    for k in (load_swap_k, store_swap_k):
-        if k and (k > s_bits or (1 << k) > grid):
-            raise ValueError(
-                f"frame-swap k={k} exceeds the call geometry "
-                f"(s_bits={s_bits}, grid={grid})")
+    for k, hi in ((load_swap_k, load_swap_hi), (store_swap_k, store_swap_hi)):
+        if k:
+            hi = tile_bits if hi is None else hi
+            if k > s_bits or hi < tile_bits or hi + k > n:
+                raise ValueError(
+                    f"bit-block swap (k={k}, hi={hi}) exceeds the call "
+                    f"geometry (tile_bits={tile_bits}, n={n})")
 
     # lane_u block matrices become pallas operands (replicated per program);
     # their op entries carry the operand index instead of the matrix
@@ -610,13 +685,19 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
     x = amps.reshape(2, rows, _LANES)
     plain = pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
                          memory_space=pltpu.VMEM)
-    x_in = _swap_view(x, grid, s, load_swap_k) if load_swap_k else x
-    in_spec0 = _swap_spec(s, load_swap_k) if load_swap_k else plain
+    lo2_load = (load_swap_hi if load_swap_hi is not None else tile_bits)
+    lo2_store = (store_swap_hi if store_swap_hi is not None else tile_bits)
+    if load_swap_k:
+        x_in = _swap_view(x, rows, s, lo2_load - LANE_BITS, load_swap_k)
+        in_spec0 = _swap_spec(s, lo2_load - LANE_BITS, load_swap_k)
+    else:
+        x_in = x
+        in_spec0 = plain
     if store_swap_k:
-        dk = 1 << store_swap_k
         out_shape = jax.ShapeDtypeStruct(
-            (2, grid // dk, dk, dk, s >> store_swap_k, _LANES), x.dtype)
-        out_spec = _swap_spec(s, store_swap_k)
+            _swap_view(x, rows, s, lo2_store - LANE_BITS,
+                       store_swap_k).shape, x.dtype)
+        out_spec = _swap_spec(s, lo2_store - LANE_BITS, store_swap_k)
     else:
         out_shape = jax.ShapeDtypeStruct(x.shape, x.dtype)
         out_spec = plain

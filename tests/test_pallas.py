@@ -103,6 +103,47 @@ def test_density_tapes_ride_pallas_with_shadow_ops():
                                atol=TOL, rtol=TOL)
 
 
+def test_density_channels_fuse_into_pallas_runs():
+    """Round-3 channel fast path: single-target Kraus channels capture as
+    'kraus1' kernel ops and dephasing as extended diagonals, all riding
+    the same PallasRun as the unitaries; a 2-target depolarising stays a
+    barrier. Replay matches the eager engine."""
+    n = 5
+    c = Circuit(n, is_density_matrix=True)
+    for q in range(3):
+        c.hadamard(q)
+    c.controlledNot(0, 1)
+    c.mixDepolarising(0, 0.05)
+    c.mixDamping(2, 0.1)
+    k = 1 / np.sqrt(2)
+    c.mixKrausMap(1, [np.array([[k, 0], [0, k]]),
+                      np.array([[0, k], [k, 0]])])
+    c.mixDephasing(3, 0.2)
+    c.mixTwoQubitDephasing(0, 1, 0.1)
+    c.mixTwoQubitDepolarising(0, 1, 0.1)
+    fz = c.fused(max_qubits=4, pallas=True)
+    run_ops = [op for f, a, _ in fz._tape
+               if f.__name__ == "_apply_pallas_run" for op in a[0]]
+    kinds = [op[0] for op in run_ops]
+    assert kinds.count("kraus1") == 3
+    assert kinds.count("diagw") == 2  # both dephasings, extended coords
+    barriers = [f.__name__ for f, _, _ in fz._tape
+                if f.__name__ not in ("_apply_pallas_run",)]
+    assert "mixTwoQubitDepolarising" in barriers
+
+    env = qt.createQuESTEnv()
+    rho = qt.createDensityQureg(n, env)
+    qt.initPlusState(rho)
+    ref = qt.createDensityQureg(n, env)
+    qt.initPlusState(ref)
+    fz.run(rho)
+    for f, a, kw in c._tape:
+        f(ref, *a, **kw)
+    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
+                               atol=TOL, rtol=TOL)
+    assert abs(qt.calcTotalProb(rho) - 1.0) < TOL
+
+
 def test_density_pallas_with_frame_swaps_matches_oracle():
     """Density planning where column qubits exceed the tile: shadow ops on
     grid bits force frame swaps; amplitudes must match the eager engine."""
