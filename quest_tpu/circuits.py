@@ -78,6 +78,65 @@ def _resolve(name):
         f"(measurement and calc* functions must run eagerly)")
 
 
+#: modules whose tape entries route EVERY amps access through the explicit
+#: scheduler's coordinate remapping -- safe to run under a deferred layout
+_DEFER_SAFE_MODULES = ("quest_tpu.gates", "quest_tpu.decoherence")
+
+
+def _defer_safe(f) -> bool:
+    """True if tape entry ``f`` may run while the scheduler's deferred
+    qubit layout is non-identity. Gate and channel entries remap their
+    coordinates through the scheduler; fused dense/diag blocks route
+    through the same gate primitives. Everything else (inits, phase
+    functions, operators acting on raw amplitude order, Pallas runs and
+    frame swaps) assumes the identity layout and forces reconciliation."""
+    from . import fusion
+
+    if getattr(f, "__module__", None) in _DEFER_SAFE_MODULES:
+        return True
+    return f is fusion._apply_dense_block
+
+
+def _tape_accesses(tape, num_qubits, is_density, dtype):
+    """Per-entry logical-qubit access sets for the deferred scheduler's
+    Belady eviction (None = barrier). Dense/diag fused blocks expose their
+    qubits directly; raw gate entries are spy-captured; density row events
+    gain their conj-shadow column coordinates."""
+    from . import fusion
+
+    out = []
+    for f, args, kwargs in tape:
+        if not _defer_safe(f):
+            out.append(None)
+            continue
+        if f is fusion._apply_dense_block:
+            qs = set(args[1])
+            if is_density:
+                qs |= {q + num_qubits for q in qs}
+            out.append(frozenset(qs))
+            continue
+        if getattr(f, "__name__", "") == "_apply_gate_diag":
+            # DiagBlock tape entries: (diag, qubits)
+            qs = set(args[1])
+            if is_density:
+                qs |= {q + num_qubits for q in qs}
+            out.append(frozenset(qs))
+            continue
+        events = fusion.capture(f, args, kwargs, num_qubits, dtype,
+                                is_density=is_density)
+        if events is None:
+            out.append(None)
+            continue
+        qs = set()
+        for ev in events:
+            s = set(ev.support)
+            if is_density and not ev.extended:
+                s |= {q + num_qubits for q in s}
+            qs |= s
+        out.append(frozenset(qs))
+    return out
+
+
 def _amps_mesh(amps):
     """The 1-D amps mesh a (concrete) amplitude array is sharded over, or
     None for single-device / traced arrays."""
@@ -143,15 +202,49 @@ class Circuit:
     # -- execution ----------------------------------------------------------
 
     def as_fn(self):
-        """Pure amps->amps function replaying the tape (jit-compatible)."""
+        """Pure amps->amps function replaying the tape (jit-compatible).
+
+        Under an active explicit-mesh scheduler the replay runs in DEFERRED
+        permutation mode (parallel.scheduler.DistributedScheduler): gate
+        relocation swap-backs are elided and the qubit layout reconciles to
+        identity only at barrier entries and at replay end. Entries that
+        bypass the scheduler's coordinate remapping (state inits, phase
+        functions, Pallas runs) are barriers; gate/channel/dense-block
+        entries defer."""
+        from .parallel import scheduler as _dist
+
         tape = tuple(self._tape)
         num_qubits, is_density = self.num_qubits, self.is_density_matrix
+        nsv = (2 if is_density else 1) * num_qubits
+
+        lookahead_cell = []  # memoized across retraces
 
         def fn(amps):
             shell = Qureg(num_qubits, is_density, amps, env=None)
-            for f, args, kwargs in tape:
-                f(shell, *args, **kwargs)
-            return shell.amps
+            sched = _dist.active()
+            started = sched.begin_defer() if sched is not None else False
+            try:
+                if started:
+                    if not lookahead_cell:
+                        lookahead_cell.append(_tape_accesses(
+                            tape, num_qubits, is_density, shell.dtype))
+                    sched.set_lookahead(lookahead_cell[0])
+                for i, (f, args, kwargs) in enumerate(tape):
+                    if sched is not None and sched.deferring:
+                        sched.advance(i)
+                        if not _defer_safe(f):
+                            shell.put(sched.reconcile(shell.amps, nsv))
+                    f(shell, *args, **kwargs)
+                if started:
+                    shell.put(sched.end_defer(shell.amps, nsv))
+                    sched.set_lookahead(None)
+                return shell.amps
+            except BaseException:
+                if started:
+                    # the amps are being discarded; a stale non-identity
+                    # layout must not leak into the next replay
+                    sched.abort_defer()
+                raise
 
         return fn
 

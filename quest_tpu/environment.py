@@ -40,6 +40,11 @@ class QuESTEnv:
     mesh: Optional[Mesh]
     seeds: list[int] = field(default_factory=list)
     rng: np.random.RandomState = None
+    #: pod-slice count of the device set (1 = single slice). Devices are
+    #: ordered slice-major, so the chip axis forms the LOW shard bits (hot
+    #: relocation targets ride ICI) and only the top log2(num_slices)
+    #: sharded qubits cross DCN; parallel.mesh.shard_bit_link classifies.
+    num_slices: int = 1
 
     # kept for reference API parity (reportQuESTEnv prints them)
     @property
@@ -75,11 +80,16 @@ class QuESTEnv:
         return NamedSharding(self.mesh, PartitionSpec())
 
 
-def createQuESTEnv(devices: Sequence[jax.Device] | None = None) -> QuESTEnv:
+def createQuESTEnv(devices: Sequence[jax.Device] | None = None,
+                   num_slices: int | None = None) -> QuESTEnv:
     """Create the environment (createQuESTEnv, QuEST.h:2196).
 
     ``devices`` defaults to all visible devices; a power-of-2 count is
     required (same constraint as the reference's validateNumRanks).
+    ``num_slices`` declares a multi-slice (DCN-connected) topology: devices
+    are ordered slice-major so intra-slice chips form the minor shard bits
+    (hot qubits ride ICI; see parallel.mesh). Auto-detected from the TPU
+    runtime's ``slice_index`` attribute when omitted.
     """
     func = "createQuESTEnv"
     if devices is None:
@@ -88,8 +98,23 @@ def createQuESTEnv(devices: Sequence[jax.Device] | None = None) -> QuESTEnv:
         count = 1 << (len(devices).bit_length() - 1)
         devices = devices[:count]
     validation.validate_num_ranks(len(devices), func)
+    explicit_slices = num_slices is not None
+    if num_slices is None:
+        num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    bad = (num_slices < 1 or len(devices) % num_slices
+           or num_slices & (num_slices - 1))
+    if bad:
+        if explicit_slices:
+            raise validation.QuESTError(
+                f"num_slices={num_slices} does not evenly split "
+                f"{len(devices)} devices into power-of-2 slices")
+        num_slices = 1  # auto-detect is stats-only; never reject hardware
+    if num_slices > 1:
+        # slice-major order (chip axis = minor shard bits -> hot qubits
+        # ride ICI), stable within a slice to preserve the caller's order
+        devices = sorted(devices, key=lambda d: getattr(d, "slice_index", 0))
     mesh = Mesh(np.asarray(devices), (AMP_AXIS,))
-    env = QuESTEnv(mesh=mesh)
+    env = QuESTEnv(mesh=mesh, num_slices=num_slices)
     seedQuESTDefault(env)
     return env
 

@@ -185,10 +185,15 @@ def capture(fn, args, kwargs, num_qubits: int, dtype,
     density spy with the channel appliers patched -- their events carry
     flattened-state coordinates and ``extended=True``.
     """
+    from .parallel import scheduler as _dist
+
     events: list = []
     shell = _SpyQureg(num_qubits, False, dtype)
     try:
-        with _capture_ctx(events):
+        # suspend any active distributed scheduler: the spy replay must not
+        # route through (or mutate) it -- swapGate's inline dispatch would
+        # otherwise record phantom virtual swaps in its layout/stats
+        with _dist.explicit_mesh(None), _capture_ctx(events):
             fn(shell, *args, **kwargs)
         return events if events else None
     except Exception:
@@ -198,7 +203,8 @@ def capture(fn, args, kwargs, num_qubits: int, dtype,
     events = []
     shell = _SpyQureg(num_qubits, True, dtype)
     try:
-        with _capture_ctx(events), _channel_capture_ctx(events):
+        with _dist.explicit_mesh(None), _capture_ctx(events), \
+                _channel_capture_ctx(events):
             fn(shell, *args, **kwargs)
     except Exception:
         return None
@@ -850,6 +856,13 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
         _apply_ops_via_engine(qureg, ops)
         post_swap()
         return
+    if not _mosaic_supports(qureg.dtype):
+        # f64 on the TPU backend: no Mosaic lowering; XLA engine replay
+        # (with explicit frame-swap passes) is the documented policy
+        pre_swap()
+        _apply_ops_via_engine(qureg, ops)
+        post_swap()
+        return
     # single device: fold the swaps into the kernel DMA when this register's
     # tile geometry matches the plan's (s_low >= one sublane tile keeps the
     # gathered chunks layout-free); otherwise run them as explicit passes
@@ -902,6 +915,8 @@ def _run_pallas_sharded(qureg, ops: tuple, mesh):
     from .ops import pallas_gates as PG
 
     if tuple(mesh.shape.keys()) != (AMP_AXIS,):
+        return None
+    if not _mosaic_supports(qureg.dtype):
         return None
     ndev = mesh.shape[AMP_AXIS]
     if ndev & (ndev - 1):
@@ -984,13 +999,26 @@ def _apply_ops_via_engine(qureg, ops: tuple) -> None:
             raise ValueError(f"unknown pallas op {op[0]!r}")
 
 
+def _mosaic_supports(dtype) -> bool:
+    """Mosaic (TPU Pallas) has no f64 lowering for the kernel's MXU dots;
+    f64 registers on TPU take the XLA engine paths instead (XLA emulates
+    f64 on TPU -- slow but correct, the documented QUEST_PRECISION=2
+    policy; see precision.py)."""
+    import jax
+    import numpy as np
+
+    if jax.default_backend() != "tpu":
+        return True  # CPU interpreter handles f64
+    return np.dtype(dtype) != np.dtype("float64")
+
+
 def _pallas_usable(qureg) -> bool:
     import jax
 
     sharding = getattr(qureg.amps, "sharding", None)
     if sharding is not None and len(sharding.device_set) > 1:
         return False
-    return jax.default_backend() == "tpu"
+    return jax.default_backend() == "tpu" and _mosaic_supports(qureg.dtype)
 
 
 def _apply_dense_block(qureg, U: np.ndarray, qubits: tuple) -> None:

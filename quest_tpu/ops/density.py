@@ -139,6 +139,8 @@ def _kraus_sum_pallas(amps, terms, n, t, lq=None):
     nsv = 2 * n
     if amps.shape[-1] < 2 * PG._LANES:
         return None
+    if not _fusion._mosaic_supports(amps.dtype):
+        return None  # f64 on TPU: no Mosaic lowering (engine path)
     sharding = getattr(amps, "sharding", None)
     if sharding is not None and len(sharding.device_set) > 1:
         return None  # pallas_call would gather the shards

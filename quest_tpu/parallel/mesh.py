@@ -15,7 +15,6 @@ from jax.sharding import Mesh
 
 from ..environment import AMP_AXIS
 
-
 def local_qubit_count(n: int, mesh: Mesh | None) -> int:
     """Number of low qubits entirely local to each shard."""
     if mesh is None or mesh.size == 1:
@@ -28,3 +27,15 @@ def shard_info(n: int, mesh: Mesh | None):
     """(num_local_qubits, num_shard_qubits, axis_name)."""
     nl = local_qubit_count(n, mesh)
     return nl, n - nl, AMP_AXIS
+
+
+def shard_bit_link(n: int, mesh: Mesh | None, num_slices: int,
+                   qubit: int) -> str | None:
+    """Which interconnect a comm op on sharded ``qubit`` rides: 'ici'
+    (intra-slice chip axis, the low shard bits) or 'dcn' (inter-slice,
+    the top log2(num_slices) shard bits); None for local qubits."""
+    nl = local_qubit_count(n, mesh)
+    if qubit < nl:
+        return None
+    chip_bits = ((mesh.size // max(num_slices, 1)) - 1).bit_length()
+    return "ici" if (qubit - nl) < chip_bits else "dcn"

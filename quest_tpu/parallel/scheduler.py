@@ -37,13 +37,56 @@ _STATE = threading.local()
 
 @dataclass
 class DistributedScheduler:
-    """Stateless-per-gate dispatcher bound to a mesh; collects plan stats
-    (number of pair exchanges / relocations / comm-free ops) at trace time."""
+    """Gate dispatcher bound to a mesh; collects plan stats (number of pair
+    exchanges / relocations / comm-free ops) at trace time.
+
+    Two dispatch modes:
+
+    - **immediate** (default, the reference's policy): every relocation is
+      undone right after its gate (QuEST_cpu_distributed.c:1526-1568), so
+      the register is always in the identity layout.
+    - **deferred** (``begin_defer``, active during ``Circuit`` replays):
+      relocation swap-backs are elided. The scheduler keeps a
+      logical->physical qubit permutation; gate coordinates are remapped
+      through it, a sharded qubit relocated once stays local for every
+      subsequent gate (evicting the least-recently-used local qubit), and
+      uncontrolled SWAP gates become pure permutation updates -- zero
+      communication AND zero compute. The layout is reconciled back to
+      identity only at barriers (non-gate tape entries) and at replay end.
+      This is where the build stops mirroring the reference's
+      one-relocation-per-gate scheme and beats it (SURVEY.md section 5:
+      "gate scheduling / qubit-index remapping to keep hot qubits local").
+    """
 
     mesh: Mesh
+    #: pod-slice count for ICI-vs-DCN traffic classification (1 = all ICI)
+    num_slices: int = 1
+    #: False forces the reference's immediate policy (begin_defer no-ops)
+    allow_defer: bool = True
     stats: dict = field(default_factory=lambda: {
         "pair_exchanges": 0, "relocation_swaps": 0, "rank_permutes": 0,
-        "comm_free": 0, "local": 0, "channel_superops": 0})
+        "comm_free": 0, "local": 0, "channel_superops": 0,
+        "virtual_swaps": 0, "reconcile_swaps": 0,
+        "ici_chunks": 0.0, "dcn_chunks": 0.0})
+
+    def _count_comm(self, n: int, qubit: int, chunks: float) -> None:
+        """Attribute ``chunks`` of traffic to the interconnect the comm op
+        on sharded physical ``qubit`` rides (slice-major device order: low
+        shard bits = ICI chip axis, top log2(num_slices) bits = DCN)."""
+        from .mesh import shard_bit_link
+
+        link = shard_bit_link(n, self.mesh, self.num_slices, qubit)
+        if link is not None:
+            self.stats[f"{link}_chunks"] += chunks
+
+    def __post_init__(self):
+        self.deferring = False
+        self._pos = None        # logical qubit -> physical position
+        self._occ = None        # physical position -> logical qubit
+        self._last_use = None   # logical qubit -> last-touch counter
+        self._clock = 0
+        self._future = None     # per-tape-entry access sets (Belady)
+        self._cursor = 0
 
     def comm_volume(self, n: int, bytes_per_amp: int = 8) -> dict:
         """Trace-time communication-volume estimate for the collected plan,
@@ -51,112 +94,302 @@ class DistributedScheduler:
         a non-local 1q gate exchanges a full chunk send+recv per rank,
         QuEST_cpu_distributed.c:495-533; a relocation/odd-parity swap moves
         half a chunk each way, :1443-1459; an X-class rank permute
-        re-routes the full chunk). ``bytes_per_amp`` = 8 for planar f32
-        (re+im), 16 for f64."""
+        re-routes the full chunk; a reconciliation swap costs like a
+        relocation; a virtual swap costs nothing). ``bytes_per_amp`` = 8
+        for planar f32 (re+im), 16 for f64."""
         chunk = (1 << n) // self.mesh.size
-        s = self.stats
-        amps_moved = chunk * (2.0 * s["pair_exchanges"]
-                              + 1.0 * s["relocation_swaps"]
-                              + 2.0 * s["rank_permutes"])
+        amps_moved = chunk * comm_chunks(self.stats)
         return {
             "amps_per_device": amps_moved,
             "bytes_per_device": amps_moved * bytes_per_amp,
             "chunk_amps": chunk,
         }
 
+    # -- deferred-permutation machinery --------------------------------------
+
+    def begin_defer(self) -> bool:
+        """Enter deferred mode; returns False if already deferring or
+        deferral is disabled (the caller then must not end it)."""
+        if self.deferring or not self.allow_defer:
+            return False
+        self.deferring = True
+        return True
+
+    def end_defer(self, amps, n: int):
+        """Reconcile the layout to identity and leave deferred mode."""
+        amps = self.reconcile(amps, n)
+        self.deferring = False
+        return amps
+
+    def abort_defer(self) -> None:
+        """Drop deferred state WITHOUT reconciling -- for exception paths
+        where the amps are being discarded anyway. Leaving a stale layout
+        active would silently corrupt the next replay."""
+        self.deferring = False
+        self._pos = self._occ = self._last_use = None
+        self._future = None
+        self._cursor = 0
+
+    def set_lookahead(self, accesses) -> None:
+        """Future qubit-access sequence for Belady eviction: one entry per
+        tape item -- a frozenset of the logical qubits it touches, or None
+        for a barrier (layout reconciles there, so nothing beyond a barrier
+        matters for eviction). Circuit.as_fn installs this."""
+        self._future = list(accesses) if accesses is not None else None
+        self._cursor = 0
+
+    def advance(self, index: int) -> None:
+        self._cursor = index
+
+    def _next_use(self, lq: int) -> int:
+        """Tape index of the next access to logical qubit ``lq`` (cursor
+        inclusive -- the current entry's own qubits must never be evicted);
+        a large sentinel if unused before the next barrier."""
+        for j in range(self._cursor, len(self._future)):
+            s = self._future[j]
+            if s is None:
+                break  # reconciliation point: later uses are irrelevant
+            if lq in s:
+                return j
+        return 1 << 30
+
+    def _ensure_perm(self, n: int) -> None:
+        if self._pos is None or len(self._pos) != n:
+            self._pos = list(range(n))
+            self._occ = list(range(n))
+            self._last_use = [0] * n
+
+    def _map(self, n, qs) -> tuple:
+        """Logical -> physical coordinates under the current layout."""
+        if self._pos is None:
+            return tuple(qs)
+        self._ensure_perm(n)
+        return tuple(self._pos[q] for q in qs)
+
+    def _touch(self, qs) -> None:
+        self._clock += 1
+        if self._last_use is not None:
+            for q in qs:
+                self._last_use[q] = self._clock
+
+    def _swap_positions(self, a: int, b: int) -> None:
+        """Record a PHYSICAL swap of positions a and b in the layout."""
+        la, lb = self._occ[a], self._occ[b]
+        self._occ[a], self._occ[b] = lb, la
+        self._pos[la], self._pos[lb] = b, a
+
+    def reconcile(self, amps, n: int):
+        """Physically restore the identity layout (logical q at position q)
+        with at most one swap per displaced qubit (cycle restoration).
+        Swaps touching a sharded position are counted as comm traffic;
+        local-local ones are free relabelings."""
+        if self._pos is None:
+            return amps
+        self._ensure_perm(n)
+        nl = local_qubit_count(n, self.mesh)
+        for a in range(n):
+            while self._occ[a] != a:
+                b = self._pos[a]  # where logical a currently lives
+                key = "reconcile_swaps" if max(a, b) >= nl else "local"
+                self.stats[key] += 1
+                if max(a, b) >= nl:
+                    self._count_comm(n, max(a, b), 1.0)
+                amps = X.dist_swap(amps, n=n, qb1=a, qb2=b, mesh=self.mesh)
+                self._swap_positions(a, b)
+        return amps
+
+    def _relocate(self, amps, n, nl, phys_ts, support_phys,
+                  on_fail: str = "raise"):
+        """Swap each sharded physical position in ``phys_ts`` with a free
+        local slot (deferred mode: LRU-occupant slot, no swap-back --
+        callers read the new positions from the layout afterwards).
+        Returns (amps, {old_phys: new_phys})."""
+        shard = [p for p in phys_ts if p >= nl]
+        if not shard:
+            return amps, {}
+        free = [p for p in range(nl) if p not in support_phys]
+        if len(free) < len(shard):
+            if on_fail == "none":
+                # the caller has a relocation-free route (pair exchange /
+                # rank permute); never error where immediate mode wouldn't
+                return amps, None
+            # surface through the overridable error hook, as the reference's
+            # matrix-fits-in-node check (validateMultiQubitMatrixFitsInNode,
+            # QuEST_validation.c:522-524, E_CANNOT_FIT_MULTI_QUBIT_MATRIX)
+            from .. import validation as V
+            V.validate_matrix_fits_in_node(len(free), len(shard),
+                                           "applyMatrix")
+        if self.deferring:
+            self._ensure_perm(n)
+            if getattr(self, "_future", None) is not None:
+                # Belady: evict the occupant whose next use is farthest
+                # (or never, before the next reconciliation barrier)
+                free.sort(key=lambda p: -self._next_use(self._occ[p]))
+            else:
+                # no lookahead (eager deferral): least-recently-used,
+                # preferring high slots on ties (low qubits run hot)
+                free.sort(key=lambda p: (self._last_use[self._occ[p]], -p))
+        relocation = {}
+        for s, f in zip(shard, free):
+            self.stats["relocation_swaps"] += 1
+            self._count_comm(n, s, 1.0)
+            amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh)
+            if self.deferring:
+                self._swap_positions(f, s)
+            relocation[s] = f
+        return amps, relocation
+
     # -- dense matrices -----------------------------------------------------
 
     def apply_matrix(self, amps, matrix, *, n, targets, controls=(),
                      control_states=(), conj=False):
         nl = local_qubit_count(n, self.mesh)
-        shard_ts = [t for t in targets if t >= nl]
+        self._touch(targets)
+        p_targets = self._map(n, targets)
+        p_controls = self._map(n, controls)
+        shard_ts = [t for t in p_targets if t >= nl]
         if not shard_ts:
             self.stats["local"] += 1
             return X.dist_apply_local_matrix(
-                amps, matrix, n=n, targets=tuple(targets),
-                controls=tuple(controls), control_states=tuple(control_states),
+                amps, matrix, n=n, targets=p_targets,
+                controls=p_controls, control_states=tuple(control_states),
                 conj=conj, mesh=self.mesh)
+        support = set(p_targets) | set(p_controls)
         if len(targets) == 1:
-            self.stats["pair_exchanges"] += 1
-            return X.dist_apply_matrix1(
-                amps, matrix, n=n, target=targets[0], controls=tuple(controls),
-                control_states=tuple(control_states), conj=conj, mesh=self.mesh)
-        # n-target: relocate sharded targets to free local qubits, apply,
-        # swap back (reference :1526-1568). Local slots are chosen low-first
-        # among qubits outside the gate's support.
-        support = set(targets) | set(controls)
-        free = [q for q in range(nl) if q not in support]
-        if len(free) < len(shard_ts):
-            # surface through the overridable error hook, as the reference's
-            # matrix-fits-in-node check (validateMultiQubitMatrixFitsInNode,
-            # QuEST_validation.c:522-524, E_CANNOT_FIT_MULTI_QUBIT_MATRIX)
-            from .. import validation as V
-            V.validate_matrix_fits_in_node(len(free), len(shard_ts),
-                                           "applyMatrix")
-        relocation = dict(zip(shard_ts, free))
-        for s, f in relocation.items():
-            amps = self.apply_swap(amps, n=n, qb1=f, qb2=s)
-        new_targets = tuple(relocation.get(t, t) for t in targets)
-        new_controls = tuple(relocation.get(c, c) for c in controls)
+            # the reference's policy: full-chunk pair exchange per gate
+            # (QuEST_cpu_distributed.c:870-905). Deferred mode relocates
+            # instead (half the traffic now, zero for later gates on the
+            # same qubit) and falls back to the pair exchange when no
+            # local slot is free.
+            relocation = None
+            if self.deferring:
+                amps, relocation = self._relocate(amps, n, nl, p_targets,
+                                                  support, on_fail="none")
+            if relocation is None:
+                self.stats["pair_exchanges"] += 1
+                self._count_comm(n, p_targets[0], 2.0)
+                return X.dist_apply_matrix1(
+                    amps, matrix, n=n, target=p_targets[0],
+                    controls=p_controls,
+                    control_states=tuple(control_states), conj=conj,
+                    mesh=self.mesh)
+            self.stats["local"] += 1
+            return X.dist_apply_local_matrix(
+                amps, matrix, n=n,
+                targets=tuple(relocation.get(t, t) for t in p_targets),
+                controls=tuple(relocation.get(c, c) for c in p_controls),
+                control_states=tuple(control_states), conj=conj,
+                mesh=self.mesh)
+        # relocate sharded targets to free local slots, apply locally;
+        # immediate mode swaps back (reference :1526-1568), deferred mode
+        # leaves the new layout in place
+        amps, relocation = self._relocate(amps, n, nl, p_targets, support)
+        new_targets = tuple(relocation.get(t, t) for t in p_targets)
+        new_controls = tuple(relocation.get(c, c) for c in p_controls)
         self.stats["local"] += 1
         amps = X.dist_apply_local_matrix(
             amps, matrix, n=n, targets=new_targets, controls=new_controls,
             control_states=tuple(control_states), conj=conj, mesh=self.mesh)
-        for s, f in relocation.items():
-            amps = self.apply_swap(amps, n=n, qb1=f, qb2=s)
+        if not self.deferring:
+            for s, f in relocation.items():
+                self.stats["relocation_swaps"] += 1
+                self._count_comm(n, s, 1.0)
+                amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh)
         return amps
 
     # -- permutation class --------------------------------------------------
 
     def apply_x(self, amps, *, n, targets, controls=(), control_states=()):
         nl = local_qubit_count(n, self.mesh)
-        if any(t >= nl for t in targets):
-            self.stats["rank_permutes"] += 1
-        else:
+        self._touch(tuple(targets) + tuple(controls))
+        p_targets = self._map(n, targets)
+        p_controls = self._map(n, controls)
+        if not any(t >= nl for t in p_targets):
             self.stats["local"] += 1
-        return X.dist_apply_x(amps, n=n, targets=tuple(targets),
-                              controls=tuple(controls),
+            return X.dist_apply_x(amps, n=n, targets=p_targets,
+                                  controls=p_controls,
+                                  control_states=tuple(control_states),
+                                  mesh=self.mesh)
+        relocation = None
+        if self.deferring:
+            # relocate sharded X targets too: a rank permute re-routes the
+            # full chunk (2 units) where a relocation moves half each way
+            # (1 unit) and leaves the qubit resident for later gates;
+            # fall back to the rank permute when no local slot is free
+            support = set(p_targets) | set(p_controls)
+            amps, relocation = self._relocate(amps, n, nl, p_targets,
+                                              support, on_fail="none")
+        if relocation is not None:
+            p_targets = tuple(relocation.get(t, t) for t in p_targets)
+            p_controls = tuple(relocation.get(c, c) for c in p_controls)
+            self.stats["local"] += 1
+        else:
+            self.stats["rank_permutes"] += 1
+            self._count_comm(n, max(t for t in p_targets if t >= nl), 2.0)
+        return X.dist_apply_x(amps, n=n, targets=p_targets,
+                              controls=p_controls,
                               control_states=tuple(control_states),
                               mesh=self.mesh)
 
     def apply_swap(self, amps, *, n, qb1, qb2):
+        self._touch((qb1, qb2))
+        if self.deferring:
+            # an uncontrolled SWAP gate is a pure relabeling: update the
+            # layout, move no data at all (the reference's swapQubitAmps
+            # always pays an odd-parity exchange, :1443-1459)
+            self._ensure_perm(n)
+            p1, p2 = self._pos[qb1], self._pos[qb2]
+            self._swap_positions(p1, p2)
+            self.stats["virtual_swaps"] += 1
+            return amps
+        p1, p2 = self._map(n, (qb1, qb2))
         nl = local_qubit_count(n, self.mesh)
-        both_local = max(qb1, qb2) < nl
+        both_local = max(p1, p2) < nl
         if both_local:
             self.stats["local"] += 1
-        elif min(qb1, qb2) >= nl:
+        elif min(p1, p2) >= nl:
             self.stats["rank_permutes"] += 1
+            self._count_comm(n, max(p1, p2), 2.0)
         else:
             self.stats["relocation_swaps"] += 1
-        return X.dist_swap(amps, n=n, qb1=qb1, qb2=qb2, mesh=self.mesh)
+            self._count_comm(n, max(p1, p2), 1.0)
+        return X.dist_swap(amps, n=n, qb1=p1, qb2=p2, mesh=self.mesh)
 
     # -- diagonal family (always comm-free) ---------------------------------
 
     def apply_diagonal(self, amps, diag, *, n, targets, controls=(),
                        control_states=(), conj=False):
         self.stats["comm_free"] += 1
+        self._touch(targets)
         return X.dist_apply_diag_phase(
-            amps, diag, n=n, targets=tuple(targets), controls=tuple(controls),
+            amps, diag, n=n, targets=self._map(n, targets),
+            controls=self._map(n, controls),
             control_states=tuple(control_states), conj=conj, mesh=self.mesh)
 
     def apply_parity_phase(self, amps, theta, *, n, qubits, controls=(),
                            control_states=(), conj=False):
         self.stats["comm_free"] += 1
+        self._touch(qubits)
         return X.dist_apply_parity_phase(
-            amps, theta, n=n, qubits=tuple(qubits), controls=tuple(controls),
+            amps, theta, n=n, qubits=self._map(n, qubits),
+            controls=self._map(n, controls),
             control_states=tuple(control_states), conj=conj, mesh=self.mesh)
 
 
 @contextmanager
-def explicit_mesh(mesh: Mesh):
-    """Route L5 gate application through the explicit shard_map kernels."""
+def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True):
+    """Route L5 gate application through the explicit shard_map kernels.
+    ``num_slices`` > 1 splits the plan's comm stats into ICI vs DCN chunks
+    (slice-major device order; parallel.mesh.shard_bit_link)."""
     from ..environment import AMP_AXIS
     if mesh is not None and mesh.size > 1 and AMP_AXIS not in mesh.shape:
         raise ValueError(
             f"explicit_mesh requires a mesh whose amplitude axis is named "
             f"'{AMP_AXIS}' (got axes {tuple(mesh.shape)}); build it with "
             f"createQuESTEnv or Mesh(devices, ('{AMP_AXIS}',))")
-    sched = DistributedScheduler(mesh) if mesh is not None and mesh.size > 1 else None
+    sched = (DistributedScheduler(mesh, num_slices=num_slices,
+                                  allow_defer=defer)
+             if mesh is not None and mesh.size > 1 else None)
     prev = getattr(_STATE, "sched", None)
     _STATE.sched = sched
     try:
@@ -170,7 +403,17 @@ def active() -> DistributedScheduler | None:
     return getattr(_STATE, "sched", None)
 
 
-def plan_circuit(circuit, mesh: Mesh):
+def comm_chunks(stats: dict) -> float:
+    """Total comm traffic of a plan in chunk units, the single source of
+    the cost-model weights (2 per pair exchange / rank permute, 1 per
+    relocation or reconciliation swap, 0 for virtual swaps) --
+    comm_volume() and every report derive from this."""
+    return (2.0 * stats["pair_exchanges"] + 1.0 * stats["relocation_swaps"]
+            + 1.0 * stats["reconcile_swaps"] + 2.0 * stats["rank_permutes"])
+
+
+def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
+                 defer: bool = True):
     """Trace ``circuit`` abstractly under the explicit scheduler and return
     its communication plan stats (no device execution -- jax.eval_shape)."""
     import jax
@@ -180,7 +423,7 @@ def plan_circuit(circuit, mesh: Mesh):
 
     nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
     num_amps = 1 << nsv
-    with explicit_mesh(mesh) as sched:
+    with explicit_mesh(mesh, num_slices=num_slices, defer=defer) as sched:
         fn = circuit.as_fn()
         jax.eval_shape(fn, jax.ShapeDtypeStruct((2, num_amps), real_dtype(None)))
     if sched is None:

@@ -199,6 +199,58 @@ def test_calc_prob_of_all_outcomes_every_sublist():
         assert np.allclose(probs, expect, atol=TOL)
 
 
+def test_sharded_sample_of_exhaustive_signatures():
+    """VERDICT r2 next #10: a deterministic ~50-signature sample of the
+    exhaustive families above, executed on the 8-device mesh -- closing
+    the exhaustive x sharded coverage hole without tripling the suite's
+    compile-bound runtime (every signature compiles a GSPMD program)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    env8 = qt.createQuESTEnv(jax.devices()[:8])
+    rng = np.random.RandomState(2718)
+
+    def fresh():
+        q = qt.createQureg(NUM_QUBITS, env8)
+        v = oracle.random_statevec(NUM_QUBITS, rng)
+        set_statevec(q, v)
+        assert len(q.amps.sharding.device_set) == 8
+        return q, v
+
+    count = 0
+    # 27 controlled-unitary splits (every 8th of the 215)
+    for ctrls, targets in itertools.islice(
+            ctrl_targ_splits(QUBITS, max_targs=2), 0, None, 8):
+        u = oracle.random_unitary(len(targets), rng)
+        q, v = fresh()
+        qt.multiControlledMultiQubitUnitary(q, list(ctrls), list(targets), u)
+        ref = oracle.apply_to_statevec(v, NUM_QUBITS, targets, u,
+                                       controls=ctrls)
+        assert np.allclose(get_statevec(q), ref, atol=TOL), (ctrls, targets)
+        count += 1
+    # 15 diagonal-unitary sublists (every 22nd of the 325)
+    for targets in itertools.islice(sublists(QUBITS), 0, None, 22):
+        k = len(targets)
+        op = qt.createSubDiagonalOp(k)
+        op.elems[:] = np.exp(1j * rng.uniform(0, 2 * np.pi, 1 << k))
+        q, v = fresh()
+        qt.diagonalUnitary(q, list(targets), op)
+        ref = oracle.apply_to_statevec(v, NUM_QUBITS, targets,
+                                       np.diag(op.elems))
+        assert np.allclose(get_statevec(q), ref, atol=TOL), targets
+        count += 1
+    # 10 Pauli-gadget sequences (every 20th of the 195)
+    seqs = [(t, c) for t in sublists(QUBITS, 1, 2) for c in pauliseqs(t)]
+    for targets, codes in seqs[::20]:
+        theta = float(rng.uniform(0, 2 * np.pi))
+        q, v = fresh()
+        qt.multiRotatePauli(q, list(targets), list(codes), theta)
+        P = oracle.pauli_product_matrix(NUM_QUBITS, targets, codes)
+        U = np.cos(theta / 2) * np.eye(DIM) - 1j * np.sin(theta / 2) * P
+        assert np.allclose(get_statevec(q), U @ v, atol=TOL), (targets, codes)
+        count += 1
+    assert count >= 50, count
+
+
 def test_mix_multi_qubit_kraus_every_target_pair():
     """mixMultiQubitKrausMap over every ordered 2-target sublist of the
     5-qubit density register (1024 elements compared per case)."""

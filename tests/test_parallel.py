@@ -115,15 +115,55 @@ def test_plan_stats_comm_free_circuit():
 
 
 def test_plan_stats_exchange_counts():
+    """Deferred-permutation policy (round 3): a sharded 1q dense gate
+    relocates once and STAYS local (no pair exchange, no swap-back);
+    repeated gates on the same qubit are then free; the layout reconciles
+    at replay end."""
     nl = local_qubit_count(5, ENV.mesh)
     circ = qt.Circuit(5)
-    circ.hadamard(nl)                       # sharded 1q dense -> 1 exchange
-    circ.hadamard(0)                        # local
-    circ.twoQubitUnitary(0, 4, np.eye(4))   # 1 reloc swap out + apply + back
+    circ.hadamard(nl)                       # sharded -> one relocation
+    circ.hadamard(nl)                       # now local: no further comm
+    circ.hadamard(nl)
     stats = plan_circuit(circ, ENV.mesh)
-    assert stats["pair_exchanges"] == 1
-    assert stats["local"] >= 2
-    assert stats["relocation_swaps"] == 2   # swap out + swap back
+    assert stats["pair_exchanges"] == 0
+    assert stats["relocation_swaps"] == 1
+    assert stats["local"] >= 3
+    # reconcile undoes the single displacement at the end
+    assert stats["reconcile_swaps"] == 1
+
+
+def test_deferred_swap_gate_is_virtual():
+    """An uncontrolled SWAP gate under the deferred scheduler moves no
+    data: pure layout update, zero comm, zero compute."""
+    nl = local_qubit_count(5, ENV.mesh)
+    circ = qt.Circuit(5)
+    circ.swapGate(0, 4)          # virtual relabel
+    circ.hadamard(4)             # logical 4 now physically at 0: local!
+    stats = plan_circuit(circ, ENV.mesh)
+    assert stats["virtual_swaps"] == 1
+    assert stats["pair_exchanges"] == 0 and stats["relocation_swaps"] == 0
+    assert stats["reconcile_swaps"] >= 1  # the relabel is undone at the end
+
+
+def test_deferred_relocation_beats_reference_policy_on_bench_circuit():
+    """VERDICT r2 next #3 'done' criterion: on the 34q bench circuit the
+    deferred scheduler cuts relocation traffic >= 40% vs the reference
+    policy it used to mirror (immediate swap-back per gate,
+    QuEST_cpu_distributed.c:1526-1568)."""
+    from __graft_entry__ import _random_layers
+    from quest_tpu.parallel.scheduler import comm_chunks
+
+    circ = qt.Circuit(34)
+    _random_layers(circ, 34, 8)
+
+    deferred = plan_circuit(circ, ENV.mesh)
+    immediate = plan_circuit(circ, ENV.mesh, defer=False)
+
+    # >= 40% less relocation/exchange traffic in chunk units (the
+    # reference policy pays 2 chunks per pair exchange / rank permute)
+    assert comm_chunks(deferred) <= 0.6 * comm_chunks(immediate), \
+        (deferred, immediate)
+    assert deferred["pair_exchanges"] == 0  # nothing uses the 2-chunk path
 
 
 def test_measurement_under_explicit_mesh():
@@ -207,20 +247,85 @@ def test_explicit_density_channels_on_circuit_tape():
     np.testing.assert_allclose(qt.get_np(q), qt.get_np(q_ref), atol=TOL)
 
 
+def test_deferred_falls_back_when_no_free_slot():
+    """A sharded 1q dense gate whose controls occupy every local slot has
+    no relocation room; deferred mode must fall back to the reference's
+    pair exchange rather than raise (immediate mode never errored here)."""
+    n = 5
+    nl = local_qubit_count(n, ENV.mesh)  # 2 local slots on the 8-dev mesh
+    circ = qt.Circuit(n)
+    circ.multiControlledUnitary(list(range(nl)), n - 1, np.eye(2))
+    stats = plan_circuit(circ, ENV.mesh)
+    assert stats["pair_exchanges"] == 1
+    # and amplitudes still agree with the single-device path
+    import jax
+    q = qt.createQureg(n, ENV)
+    qt.initPlusState(q)
+    with qt.explicit_mesh(ENV.mesh):
+        circ.run(q)
+    ref = qt.createQureg(n, qt.createQuESTEnv(jax.devices()[:1]))
+    qt.initPlusState(ref)
+    circ.run(ref)
+    np.testing.assert_allclose(np.asarray(q.amps), np.asarray(ref.amps),
+                               atol=TOL, rtol=TOL)
+
+
+def test_two_d_mesh_ici_dcn_plan_split_and_execution():
+    """VERDICT r2 next #9: an emulated 2-slice x 4-chip topology. The env
+    orders devices slice-major (chip axis = minor shard bits), execution
+    stays green on the 8-device mesh, and plan stats split the comm volume
+    into ICI vs DCN chunks -- only ops touching the TOP log2(slices)
+    sharded qubit(s) cross DCN."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    env = qt.createQuESTEnv(jax.devices()[:8], num_slices=2)
+    assert env.num_slices == 2
+
+    n = 8
+    nl = local_qubit_count(n, env.mesh)  # 5: shard bits 5(i),6(i),7(dcn)
+    circ = qt.Circuit(n)
+    circ.hadamard(nl)            # lowest shard bit: ICI relocation
+    circ.hadamard(n - 1)         # top shard bit: DCN relocation
+    stats = plan_circuit(circ, env.mesh, num_slices=env.num_slices)
+    assert stats["ici_chunks"] > 0
+    assert stats["dcn_chunks"] > 0
+    # single-slice classification: everything is ICI
+    stats1 = plan_circuit(circ, env.mesh, num_slices=1)
+    assert stats1["dcn_chunks"] == 0 and stats1["ici_chunks"] > 0
+
+    # execution on the 2-slice env matches the single-device oracle
+    q = qt.createQureg(n, env)
+    qt.initPlusState(q)
+    circ.run(q)
+    ref = qt.createQureg(n, qt.createQuESTEnv(jax.devices()[:1]))
+    qt.initPlusState(ref)
+    circ.run(ref)
+    np.testing.assert_allclose(np.asarray(q.amps), np.asarray(ref.amps),
+                               atol=TOL, rtol=TOL)
+
+
 def test_plan_comm_volume_model():
-    """plan_circuit reports the per-device communication volume using the
-    reference's cost model (full-chunk send+recv per non-local 1q gate,
-    half-chunk each way per relocation swap -- BASELINE.md comm table)."""
+    """plan_circuit's per-device communication volume follows the cost
+    model (2 chunks per pair exchange / rank permute, 1 per relocation or
+    reconciliation swap, 0 for virtual swaps -- BASELINE.md comm table),
+    consistent with whatever the reported op counts are."""
     n = 5
     circ = qt.Circuit(n)
-    circ.hadamard(n - 1)          # 1 pair exchange
-    circ.hadamard(n - 1)          # 1 more
-    circ.swapGate(1, n - 1)       # 1 mixed relocation swap
+    circ.hadamard(n - 1)
+    circ.hadamard(n - 1)          # resident after the first relocation
+    circ.swapGate(1, n - 1)       # virtual under deferral
     stats = plan_circuit(circ, ENV.mesh)
     cv = stats["comm_volume"]
     chunk = (1 << n) // ENV.mesh.size
     assert cv["chunk_amps"] == chunk
-    assert cv["amps_per_device"] == chunk * (2.0 * 2 + 1.0 * 1)
+    expect = chunk * (2.0 * stats["pair_exchanges"]
+                      + 1.0 * stats["relocation_swaps"]
+                      + 1.0 * stats["reconcile_swaps"]
+                      + 2.0 * stats["rank_permutes"])
+    assert cv["amps_per_device"] == expect
+    assert expect > 0  # the sharded hadamard cannot be free
     from quest_tpu.precision import real_dtype
     bytes_per_amp = 2 * np.dtype(real_dtype(None)).itemsize  # planar (re, im)
     assert cv["bytes_per_device"] == cv["amps_per_device"] * bytes_per_amp
