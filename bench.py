@@ -116,17 +116,36 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
 
     circ = build_circuit(n, depth)
     num_gates = len(circ)
-    # small states: pure XLA fusion (everything inlines into one program;
-    # a pallas_call is an opaque barrier that only pays off once the state
-    # is HBM-resident and bandwidth-bound), and 4x the reps -- sub-ms
-    # circuits are dispatch-bound, so short runs measure tunnel jitter
+    # 4x the reps below 22q -- sub-ms circuits are dispatch-bound, so short
+    # runs measure tunnel jitter
     if n < 22:
         reps *= 4
-    fused = circ.fused(max_qubits=5, pallas=n >= 22)
+    # two-frame pallas from 20q up: with frame swaps folded into the run
+    # DMA (round 3) the fused kernel wins well below the HBM-resident
+    # sizes (20q measured 96k gates/s pallas vs 31k XLA same-session);
+    # tiny smoke configs stay on the XLA path (one inlined program)
+    fused = circ.fused(max_qubits=5, pallas=n >= 20)
     print(f"# {n}q: fused {num_gates} gates -> {len(fused)} blocks",
           file=sys.stderr)
     if len(fused) > 48:
         fn = fused.compiled_blocks(max_gates=24, donate=True)
+    elif n < 22:
+        # sub-3ms circuits are dispatch-bound through the axon tunnel:
+        # chain INNER applications inside one program (the loop-inside-jit
+        # methodology of tools/microbench.py) so the timed region measures
+        # device work, not per-dispatch overhead
+        import jax
+
+        inner = 4
+        base = fused.as_fn()
+
+        def chained(amps):
+            for _ in range(inner):
+                amps = base(amps)
+            return amps
+
+        fn = jax.jit(chained, donate_argnums=(0,))
+        num_gates *= inner
     else:
         fn = fused.compiled(donate=True)
 

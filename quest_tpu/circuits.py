@@ -130,7 +130,9 @@ def _tape_accesses(tape, num_qubits, is_density, dtype):
         qs = set()
         for ev in events:
             s = set(ev.support)
-            if is_density and not ev.extended:
+            if is_density and (not ev.extended or ev.kind == "channel"):
+                # channel events carry ROW targets (extended only means "no
+                # shadow twin"); their column qubits are accessed too
                 s |= {q + num_qubits for q in s}
             qs |= s
         out.append(frozenset(qs))

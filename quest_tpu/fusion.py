@@ -47,10 +47,12 @@ class GateEvent:
 
     kind: 'matrix' | 'diag' | 'x' | 'parity' | 'swap' | 'channel'
 
-    'channel' events (Kraus maps on density registers) and events with
-    ``extended=True`` carry targets in FLATTENED-state coordinates
-    (column qubits at q + n already explicit) and take no conj-shadow
-    twin during density planning.
+    ``extended=True`` marks events that take no conj-shadow twin during
+    density planning. For 'diag' events captured from the dephasing
+    appliers the targets are already FLATTENED-state coordinates (column
+    qubits at q + n explicit); 'channel' events instead carry ROW targets
+    only -- their lowering (_lower_channel) and access sets
+    (circuits._tape_accesses) add the + n column coordinates themselves.
     """
     kind: str
     targets: tuple
@@ -521,6 +523,8 @@ class _FramePlanner:
             return ("swap", t[0], t[1], c, op.states)
         if op.kind == "kraus1":
             return ("kraus1", t[0], t[1], op.data)
+        if op.kind == "kraus2":
+            return ("kraus2", t[0], t[1], t[2], t[3], op.data)
         if op.kind == "diagw":
             return ("diagw", t, c, HashableMatrix(op.data))
         return ("parity", t, c, op.data)
@@ -669,19 +673,22 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
 
 
 def _lower_channel(ev: GateEvent, n: int):
-    """'channel' event -> [_POp('kraus1', (t, t+n), ...)] for single-target
-    Kraus maps, or None (multi-target channels stay barriers and run the
-    engine/fused-Kraus path). The op's data is the hashable Kraus-term
-    tuple ((sign, K2x2), ...) from the superoperator's Choi decomposition."""
+    """'channel' event -> [_POp('kraus1'|'kraus2', extended targets, ...)]
+    for 1- and 2-target Kraus maps, or None (wider channels stay barriers
+    and run the engine path). The op's data is the hashable Kraus-term
+    tuple ((sign, K), ...) from the superoperator's Choi decomposition."""
     from .ops.density import choi_kraus
     from .ops.pallas_gates import HashableMatrix
 
-    if len(ev.targets) != 1:
+    if len(ev.targets) not in (1, 2):
         return None
-    t = ev.targets[0]
     terms = tuple((float(s), HashableMatrix(k))
                   for s, k in choi_kraus(ev.superop))
-    return [_POp("kraus1", (t, t + n), (), (), terms, False)]
+    if len(ev.targets) == 1:
+        t = ev.targets[0]
+        return [_POp("kraus1", (t, t + n), (), (), terms, False)]
+    t1, t2 = ev.targets
+    return [_POp("kraus2", (t1, t2, t1 + n, t2 + n), (), (), terms, False)]
 
 
 def _shadow_pop(op: _POp, n: int) -> _POp:
@@ -934,6 +941,8 @@ def _run_pallas_sharded(qureg, ops: tuple, mesh):
                 return None
         elif op[0] in ("swap", "kraus1") and (op[1] >= lq or op[2] >= lq):
             return None
+        elif op[0] == "kraus2" and any(q >= lq for q in op[1:5]):
+            return None
 
     def body(x):
         hi = jax.lax.axis_index(AMP_AXIS)
@@ -983,16 +992,21 @@ def _apply_ops_via_engine(qureg, ops: tuple) -> None:
                 raise ValueError("swap with 0-controls has no engine route")
             qureg.put(K.apply_swap(qureg.amps, n=nsv, qb1=q1, qb2=q2,
                                    controls=controls))
-        elif op[0] == "kraus1":
+        elif op[0] in ("kraus1", "kraus2"):
             from .ops.density import _acc_kraus_term
 
-            _, t, c, terms = op
+            if op[0] == "kraus1":
+                _, t, c, terms = op
+                rows, cols = (t,), (c,)
+            else:
+                _, t1, t2, c1, c2, terms = op
+                rows, cols = (t1, t2), (c1, c2)
             amps0 = qureg.amps
             out = None
             for sign, kk in terms:
                 km = cplx.from_complex(np.asarray(kk.arr), qureg.dtype)
-                y = apply_m(amps0 + 0, km, n=nsv, targets=(t,))
-                y = apply_m(y, km, n=nsv, targets=(c,), conj=True)
+                y = apply_m(amps0 + 0, km, n=nsv, targets=rows)
+                y = apply_m(y, km, n=nsv, targets=cols, conj=True)
                 out = _acc_kraus_term(out, sign, y)
             qureg.put(out)
         else:  # pragma: no cover

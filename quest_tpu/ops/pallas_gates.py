@@ -74,6 +74,13 @@ _LANES = 1 << LANE_BITS
 #: Mosaic VMEM limit in _fused_local_run (the 16 MiB default OOMs).
 _DEF_SUBLANES = 1 << 11
 
+#: matmul precision for the in-kernel zone dots (lane_u / window). Mosaic
+#: lowers only DEFAULT and HIGHEST (Precision.HIGH raises
+#: NotImplementedError, probed round 3); HIGHEST keeps the 26q depth-8
+#: norm drift at ~1.4e-5 after 7 circuits vs DEFAULT's ~8e-5 per circuit
+#: (BASELINE.md precision table) -- the only acceptable setting.
+_DOT_PRECISION = jax.lax.Precision.HIGHEST
+
 
 def local_qubits(n: int, sublanes: int = _DEF_SUBLANES) -> int:
     """Number of low qubits a tile holds entirely (targets must be below)."""
@@ -166,6 +173,8 @@ def _op_support(op):
         return {op[1], *op[2]}
     if op[0] in ("swap", "kraus1"):
         return {op[1], op[2], *(op[3] if op[0] == "swap" else ())}
+    if op[0] == "kraus2":
+        return {op[1], op[2], op[3], op[4]}
     if op[0] in ("diagw", "parity"):
         return {*op[1], *op[2]}
     return set(range(LANE_BITS))  # lane_u acts on the lane zone
@@ -241,7 +250,7 @@ def _fold_zone_ops(ops, tile_bits: int) -> tuple:
     accum = {z: [] for z in zones}   # zone -> [op]
 
     def zone_of(op):
-        if op[0] == "kraus1":
+        if op[0] in ("kraus1", "kraus2"):
             return None  # non-unitary: must never enter a zone's dense fold
         s = _op_support(op)
         for z in zones:
@@ -345,6 +354,39 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
         return (csr * xr - csi * xi + cpr * pr - cpi * pi,
                 csr * xi + csi * xr + cpr * pi + cpi * pr)
 
+    def mat4(xr, xi, q1, q2, M):
+        """Uncontrolled 4x4 on in-tile qubit pair (q1 low bit, q2 high bit
+        of the matrix index). Row r = the element's own (q1, q2) bits;
+        out[i] = sum_delta M[r, r^delta] * amp[i ^ delta] -- one partner
+        set per delta, coefficients selected per element by r."""
+        shape = xr.shape
+        b1 = _bit_mask(q1, shape)
+        b2 = _bit_mask(q2, shape)
+        r = b1 + 2 * b2
+        p1 = (_partner(xr, q1), _partner(xi, q1))
+        p2 = (_partner(xr, q2), _partner(xi, q2))
+        p12 = (_partner(p2[0], q1), _partner(p2[1], q1))
+        srcs = {0: (xr, xi), 1: p1, 2: p2, 3: p12}
+        acc_r = acc_i = None
+        for delta in range(4):
+            cvals = [complex(M[row, row ^ delta]) for row in range(4)]
+            if all(v == 0 for v in cvals):
+                continue
+            cr = jnp.full(shape, dtype.type(cvals[0].real))
+            ci = jnp.full(shape, dtype.type(cvals[0].imag))
+            for row in range(1, 4):
+                hit = r == row
+                cr = jnp.where(hit, dtype.type(cvals[row].real), cr)
+                ci = jnp.where(hit, dtype.type(cvals[row].imag), ci)
+            sr, si = srcs[delta]
+            tr = cr * sr - ci * si
+            ti = cr * si + ci * sr
+            acc_r = tr if acc_r is None else acc_r + tr
+            acc_i = ti if acc_i is None else acc_i + ti
+        zero = jnp.zeros(shape, dtype)
+        return (zero if acc_r is None else acc_r,
+                zero if acc_i is None else acc_i)
+
     def kernel(x_ref, hi_ref, *refs):
         w_refs = refs[:-1]
         o_ref = refs[-1]
@@ -372,7 +414,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
                 W = w_refs[op[1]][:]                          # (256, 256)
                 y = jnp.concatenate([xr, xi], axis=1)         # (S, 256)
                 y = jnp.dot(y, W, preferred_element_type=y.dtype,
-                            precision=jax.lax.Precision.HIGHEST)
+                            precision=_DOT_PRECISION)
                 xr = y[:, :_LANES]
                 xi = y[:, _LANES:]
 
@@ -391,7 +433,7 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
                 for a in range(a_cnt):
                     y = jnp.concatenate([xr4[a], xi4[a]], axis=0)
                     o = jnp.dot(W, y, preferred_element_type=y.dtype,
-                                precision=jax.lax.Precision.HIGHEST)
+                                precision=_DOT_PRECISION)
                     outs_r.append(o[:d])
                     outs_i.append(o[d:])
                 xr = jnp.concatenate(outs_r, axis=0).reshape(shape)
@@ -481,20 +523,28 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
                 xr = xr + sel * (p2r - xr)
                 xi = xi + sel * (p2i - xi)
 
-            elif op[0] == "kraus1":
-                # whole single-target channel in ONE pass: for each Kraus
-                # term apply K on the row qubit and conj(K) on the column
-                # qubit to a COPY of the registers, accumulate sign-weighted
-                # -- rho' = sum_k s_k K_k rho K_k^dagger with zero extra HBM
-                # traffic (the reference pays a dedicated kernel launch per
-                # channel, QuEST_gpu.cu:2423-2600; the round-2 build paid
-                # ~2 passes per term)
-                _, t, c, terms = op
+            elif op[0] in ("kraus1", "kraus2"):
+                # a whole 1- or 2-target channel in ONE pass: for each
+                # Kraus term apply K on the row qubit(s) and conj(K) on the
+                # column qubit(s) to a COPY of the registers, accumulate
+                # sign-weighted -- rho' = sum_k s_k K_k rho K_k^dagger with
+                # zero extra HBM traffic. The reference pays a dedicated
+                # kernel launch per channel (QuEST_gpu.cu:2423-2600) and,
+                # distributed, the 3-exchange two-qubit depolarising
+                # protocol (QuEST_cpu_distributed.c:778-868); round 2 paid
+                # ~2 passes per term.
+                if op[0] == "kraus1":
+                    _, t, c, terms = op
+                    apply_k = lambda r, i, K: mat2(*mat2(r, i, t, K),
+                                                   c, np.conj(K))
+                else:
+                    _, t1, t2, c1, c2, terms = op
+                    apply_k = lambda r, i, K: mat4(*mat4(r, i, t1, t2, K),
+                                                   c1, c2, np.conj(K))
                 acc_r = acc_i = None
                 for sign, K in terms:
                     K = np.asarray(K.arr if hasattr(K, "arr") else K)
-                    yr, yi = mat2(xr, xi, t, K)
-                    yr, yi = mat2(yr, yi, c, np.conj(K))
+                    yr, yi = apply_k(xr, xi, K)
                     if sign != 1.0:
                         yr = dtype.type(sign) * yr
                         yi = dtype.type(sign) * yi
@@ -586,6 +636,8 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
                 f"{sublanes}) = {lq}; route wide targets via ops.apply")
         if o[0] in ("swap", "kraus1") and (o[1] >= lq or o[2] >= lq):
             raise ValueError(f"{o[0]} targets {o[1:3]} must be < {lq}")
+        if o[0] == "kraus2" and any(q >= lq for q in o[1:5]):
+            raise ValueError(f"kraus2 targets {o[1:5]} must be < {lq}")
     if shard_index is None:
         shard_index = jnp.zeros((1,), jnp.int32)
         local_n = None
@@ -753,7 +805,7 @@ def _make_window_dot_kernel(ac: int, d: int):
         for a in range(ac):  # static unroll; ac is small by construction
             y = jnp.concatenate([x_ref[0, a], x_ref[1, a]], axis=0)  # (2D, Bc)
             out = jnp.dot(w, y, preferred_element_type=y.dtype,
-                          precision=jax.lax.Precision.HIGHEST)
+                          precision=_DOT_PRECISION)
             o_ref[0, a] = out[:d]
             o_ref[1, a] = out[d:]
     return kernel

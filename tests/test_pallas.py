@@ -105,9 +105,9 @@ def test_density_tapes_ride_pallas_with_shadow_ops():
 
 def test_density_channels_fuse_into_pallas_runs():
     """Round-3 channel fast path: single-target Kraus channels capture as
-    'kraus1' kernel ops and dephasing as extended diagonals, all riding
-    the same PallasRun as the unitaries; a 2-target depolarising stays a
-    barrier. Replay matches the eager engine."""
+    'kraus1' kernel ops, two-target ones as 'kraus2', dephasing as
+    extended diagonals -- all riding the same PallasRun as the unitaries.
+    Replay matches the eager engine."""
     n = 5
     c = Circuit(n, is_density_matrix=True)
     for q in range(3):
@@ -126,10 +126,9 @@ def test_density_channels_fuse_into_pallas_runs():
                if f.__name__ == "_apply_pallas_run" for op in a[0]]
     kinds = [op[0] for op in run_ops]
     assert kinds.count("kraus1") == 3
+    assert kinds.count("kraus2") == 1  # the 2-target depolarising
     assert kinds.count("diagw") == 2  # both dephasings, extended coords
-    barriers = [f.__name__ for f, _, _ in fz._tape
-                if f.__name__ not in ("_apply_pallas_run",)]
-    assert "mixTwoQubitDepolarising" in barriers
+    assert all(f.__name__ == "_apply_pallas_run" for f, _, _ in fz._tape)
 
     env = qt.createQuESTEnv()
     rho = qt.createDensityQureg(n, env)
