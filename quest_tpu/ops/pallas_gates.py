@@ -308,27 +308,12 @@ def _keep_factor(controls, states, tile_bits, shape, dtype, gbit):
     return None
 
 
-def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
-                 load_swap=None, store_swap=None):
-    """Kernel over (x_ref, hi_ref, *w_refs, o_ref); ops of kind 'lane_u'
-    carry an index into w_refs (their 256x256 block matrices arrive as
-    operands -- Pallas kernels may not capture array constants).
-
-    ``hi_ref`` is an SMEM scalar holding the shard index when the kernel
-    runs per-device inside shard_map (``local_n`` = the shard's qubit
-    count): qubit roles at q >= local_n resolve against it, so controls,
-    parity members and diagonal targets on SHARDED qubits work in-kernel
-    with zero communication -- the Pallas analogue of the scheduler's
-    rank-bit controls (parallel/exchange.py).
-
-    ``load_swap``/``store_swap`` = (dk, s_low) fold a frame-swap transpose
-    (swap_bit_blocks of the top-k sublane block with the k-bit grid block)
-    into this pass: the input block arrives frame-permuted (gathered by the
-    BlockSpec from dk strided row-chunks), and/or the output block scatters
-    back the same way. The relabeling then costs zero extra HBM passes --
-    the pass count of a two-frame circuit drops by ~2x (round-3 attack on
-    the reference hot loop QuEST_cpu.c:1682-1739; see fusion._FramePlanner).
-    """
+def _ops_body(ops, xr, xi, *, tile_bits, dtype, gbit, get_w):
+    """Apply a fused op run to one in-register tile (xr, xi): the shared
+    compute core of both kernel styles (the BlockSpec-pipelined grid
+    kernel and the manual-DMA chunk loop). ``gbit(q)`` resolves index
+    bits above the tile; ``get_w(i)`` fetches the i-th dense block
+    matrix from VMEM."""
     one = np.array(1, dtype)
 
     def mat2(xr, xi, q, M):
@@ -387,6 +372,201 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
         return (zero if acc_r is None else acc_r,
                 zero if acc_i is None else acc_i)
 
+    shape = xr.shape
+    for op in ops:
+        if op[0] == "lane_u":
+            W = get_w(op[1])                              # (256, 256)
+            y = jnp.concatenate([xr, xi], axis=1)         # (S, 256)
+            y = jnp.dot(y, W, preferred_element_type=y.dtype,
+                        precision=_DOT_PRECISION)
+            xr = y[:, :_LANES]
+            xi = y[:, _LANES:]
+
+        elif op[0] == "window":
+            # dense folded unitary on sublane window [lo, lo+span):
+            # view the tile as (A, D, B*128) and hit each A-slab with
+            # one (2D, 2D) @ (2D, B*128) MXU dot (W = [[Ur,-Ui],[Ui,Ur]])
+            _, wi, lo, span = op
+            W = get_w(wi)
+            d = 1 << span
+            blk = (1 << (lo - LANE_BITS)) * _LANES
+            a_cnt = (shape[0] * shape[1]) // (d * blk)
+            xr4 = xr.reshape(a_cnt, d, blk)
+            xi4 = xi.reshape(a_cnt, d, blk)
+            outs_r, outs_i = [], []
+            for a in range(a_cnt):
+                y = jnp.concatenate([xr4[a], xi4[a]], axis=0)
+                o = jnp.dot(W, y, preferred_element_type=y.dtype,
+                            precision=_DOT_PRECISION)
+                outs_r.append(o[:d])
+                outs_i.append(o[d:])
+            xr = jnp.concatenate(outs_r, axis=0).reshape(shape)
+            xi = jnp.concatenate(outs_i, axis=0).reshape(shape)
+
+        elif op[0] == "matrix":
+            _, q, controls, states, M = op
+            m00, m01, m10, m11 = (complex(M[0, 0]), complex(M[0, 1]),
+                                  complex(M[1, 0]), complex(M[1, 1]))
+
+            if m01 == 0 and m10 == 0:
+                # diagonal 2x2: no partner exchange at all; the target
+                # may even be a grid bit (per-program scalar select)
+                bit = gbit(q) if q >= tile_bits else _bit_mask(q, shape)
+                dr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
+                di = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
+                keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
+                if keep is not None:
+                    dr = one + keep * (dr - one)
+                    di = keep * di
+                xr, xi = (dr * xr - di * xi, dr * xi + di * xr)
+                continue
+            bit = _bit_mask(q, shape)
+
+            pr = _partner(xr, q)
+            pi = _partner(xi, q)
+
+            if (m00.imag == 0 and m01.imag == 0 and
+                    m10.imag == 0 and m11.imag == 0):
+                # real matrix (H, X, Ry...): half the arithmetic
+                csr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
+                cpr = jnp.where(bit == 0, dtype.type(m01.real), dtype.type(m10.real))
+                keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
+                if keep is not None:
+                    csr = one + keep * (csr - one)
+                    cpr = keep * cpr
+                xr, xi = (csr * xr + cpr * pr, csr * xi + cpr * pi)
+                continue
+            # coefficient planes: self = m00/m11, pair = m01/m10 by bit q
+            csr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
+            csi = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
+            cpr = jnp.where(bit == 0, dtype.type(m01.real), dtype.type(m10.real))
+            cpi = jnp.where(bit == 0, dtype.type(m01.imag), dtype.type(m10.imag))
+            # fold controls into the coefficients (identity where the
+            # control pattern misses) -- cheaper than output blending
+            keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
+            if keep is not None:
+                csr = one + keep * (csr - one)
+                csi = keep * csi
+                cpr = keep * cpr
+                cpi = keep * cpi
+            xr, xi = (csr * xr - csi * xi + cpr * pr - cpi * pi,
+                      csr * xi + csi * xr + cpr * pi + cpi * pr)
+
+        elif op[0] == "parity":
+            _, qubits, controls, theta = op
+            sign_scalar = jnp.array(1, jnp.int32)
+            par = None
+            for q in qubits:
+                if q >= tile_bits:
+                    gb = gbit(q)
+                    sign_scalar = sign_scalar * (1 - 2 * gb)
+                else:
+                    b = _bit_mask(q, shape)
+                    par = b if par is None else par ^ b
+            sign = sign_scalar.astype(dtype)
+            if par is not None:
+                sign = sign * (1 - 2 * par).astype(dtype)
+            c = dtype.type(math.cos(theta / 2))
+            s = dtype.type(math.sin(theta / 2))
+            fr = c * jnp.ones_like(sign)
+            fi = -s * sign
+            keep = _keep_factor(controls, (), tile_bits, shape, dtype, gbit)
+            if keep is not None:
+                fr = one + keep * (fr - one)
+                fi = keep * fi
+            xr, xi = (xr * fr - xi * fi, xr * fi + xi * fr)
+
+        elif op[0] == "swap":
+            _, q1, q2, controls, states = op
+            # amps where bits q1,q2 differ exchange with partner(^q1^q2)
+            p2r = _partner(_partner(xr, q1), q2)
+            p2i = _partner(_partner(xi, q1), q2)
+            differ = (_bit_mask(q1, shape) ^ _bit_mask(q2, shape)).astype(dtype)
+            keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
+            sel = differ if keep is None else differ * keep
+            xr = xr + sel * (p2r - xr)
+            xi = xi + sel * (p2i - xi)
+
+        elif op[0] in ("kraus1", "kraus2"):
+            # a whole 1- or 2-target channel in ONE pass: for each
+            # Kraus term apply K on the row qubit(s) and conj(K) on the
+            # column qubit(s) to a COPY of the registers, accumulate
+            # sign-weighted -- rho' = sum_k s_k K_k rho K_k^dagger with
+            # zero extra HBM traffic. The reference pays a dedicated
+            # kernel launch per channel (QuEST_gpu.cu:2423-2600) and,
+            # distributed, the 3-exchange two-qubit depolarising
+            # protocol (QuEST_cpu_distributed.c:778-868); round 2 paid
+            # ~2 passes per term.
+            if op[0] == "kraus1":
+                _, t, c, terms = op
+                apply_k = lambda r, i, K: mat2(*mat2(r, i, t, K),
+                                               c, np.conj(K))
+            else:
+                _, t1, t2, c1, c2, terms = op
+                apply_k = lambda r, i, K: mat4(*mat4(r, i, t1, t2, K),
+                                               c1, c2, np.conj(K))
+            acc_r = acc_i = None
+            for sign, K in terms:
+                K = np.asarray(K.arr if hasattr(K, "arr") else K)
+                yr, yi = apply_k(xr, xi, K)
+                if sign != 1.0:
+                    yr = dtype.type(sign) * yr
+                    yi = dtype.type(sign) * yi
+                acc_r = yr if acc_r is None else acc_r + yr
+                acc_i = yi if acc_i is None else acc_i + yi
+            xr, xi = acc_r, acc_i
+
+        elif op[0] == "diagw":
+            _, targets, controls, D = op
+            d = np.asarray(D.arr if hasattr(D, "arr") else D).reshape(-1)
+            # table index: in-tile target bits come from iota masks,
+            # grid-bit targets from per-program scalars (broadcasts)
+            idx = None
+            for j, q in enumerate(targets):
+                b = gbit(q) if q >= tile_bits else _bit_mask(q, shape)
+                term = b << j
+                idx = term if idx is None else idx + term
+            fr = jnp.full(shape, dtype.type(d[0].real))
+            fi = jnp.full(shape, dtype.type(d[0].imag))
+            for k in range(1, d.size):
+                hit = idx == k
+                fr = jnp.where(hit, dtype.type(d[k].real), fr)
+                fi = jnp.where(hit, dtype.type(d[k].imag), fi)
+            keep = _keep_factor(controls, (), tile_bits, shape, dtype, gbit)
+            if keep is not None:
+                fr = one + keep * (fr - one)
+                fi = keep * fi
+            xr, xi = (xr * fr - xi * fi, xr * fi + xi * fr)
+
+        else:  # pragma: no cover
+            raise ValueError(f"unknown pallas op {op[0]!r}")
+
+    return xr, xi
+
+
+def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
+                 load_swap=None, store_swap=None):
+    """BlockSpec-pipelined grid kernel over (x_ref, hi_ref, *w_refs,
+    o_ref); ops of kind 'lane_u'/'window' carry an index into w_refs
+    (their block matrices arrive as operands -- Pallas kernels may not
+    capture array constants).
+
+    ``hi_ref`` is an SMEM scalar holding the shard index when the kernel
+    runs per-device inside shard_map (``local_n`` = the shard's qubit
+    count): qubit roles at q >= local_n resolve against it, so controls,
+    parity members and diagonal targets on SHARDED qubits work in-kernel
+    with zero communication -- the Pallas analogue of the scheduler's
+    rank-bit controls (parallel/exchange.py).
+
+    ``load_swap``/``store_swap`` = (dk, s_low) fold a frame-swap transpose
+    (swap_bit_blocks of the top-k sublane block with a k-bit grid block)
+    into this pass: the input block arrives frame-permuted (gathered by the
+    BlockSpec from dk strided row-chunks), and/or the output block scatters
+    back the same way. The relabeling then costs zero extra HBM passes --
+    the pass count of a two-frame circuit drops by ~2x (round-3 attack on
+    the reference hot loop QuEST_cpu.c:1682-1739; see fusion._FramePlanner).
+    """
+
     def kernel(x_ref, hi_ref, *refs):
         w_refs = refs[:-1]
         o_ref = refs[-1]
@@ -402,180 +582,15 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
         else:
             xr = x_ref[0]
             xi = x_ref[1]
-        shape = xr.shape
 
         def gbit(q):
             if local_n is not None and q >= local_n:
                 return (hi_ref[0] >> (q - local_n)) & 1
             return _grid_bit(q, tile_bits)
 
-        for op in ops:
-            if op[0] == "lane_u":
-                W = w_refs[op[1]][:]                          # (256, 256)
-                y = jnp.concatenate([xr, xi], axis=1)         # (S, 256)
-                y = jnp.dot(y, W, preferred_element_type=y.dtype,
-                            precision=_DOT_PRECISION)
-                xr = y[:, :_LANES]
-                xi = y[:, _LANES:]
-
-            elif op[0] == "window":
-                # dense folded unitary on sublane window [lo, lo+span):
-                # view the tile as (A, D, B*128) and hit each A-slab with
-                # one (2D, 2D) @ (2D, B*128) MXU dot (W = [[Ur,-Ui],[Ui,Ur]])
-                _, wi, lo, span = op
-                W = w_refs[wi][:]
-                d = 1 << span
-                blk = (1 << (lo - LANE_BITS)) * _LANES
-                a_cnt = (shape[0] * shape[1]) // (d * blk)
-                xr4 = xr.reshape(a_cnt, d, blk)
-                xi4 = xi.reshape(a_cnt, d, blk)
-                outs_r, outs_i = [], []
-                for a in range(a_cnt):
-                    y = jnp.concatenate([xr4[a], xi4[a]], axis=0)
-                    o = jnp.dot(W, y, preferred_element_type=y.dtype,
-                                precision=_DOT_PRECISION)
-                    outs_r.append(o[:d])
-                    outs_i.append(o[d:])
-                xr = jnp.concatenate(outs_r, axis=0).reshape(shape)
-                xi = jnp.concatenate(outs_i, axis=0).reshape(shape)
-
-            elif op[0] == "matrix":
-                _, q, controls, states, M = op
-                m00, m01, m10, m11 = (complex(M[0, 0]), complex(M[0, 1]),
-                                      complex(M[1, 0]), complex(M[1, 1]))
-
-                if m01 == 0 and m10 == 0:
-                    # diagonal 2x2: no partner exchange at all; the target
-                    # may even be a grid bit (per-program scalar select)
-                    bit = gbit(q) if q >= tile_bits else _bit_mask(q, shape)
-                    dr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
-                    di = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
-                    keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
-                    if keep is not None:
-                        dr = one + keep * (dr - one)
-                        di = keep * di
-                    xr, xi = (dr * xr - di * xi, dr * xi + di * xr)
-                    continue
-                bit = _bit_mask(q, shape)
-
-                pr = _partner(xr, q)
-                pi = _partner(xi, q)
-
-                if (m00.imag == 0 and m01.imag == 0 and
-                        m10.imag == 0 and m11.imag == 0):
-                    # real matrix (H, X, Ry...): half the arithmetic
-                    csr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
-                    cpr = jnp.where(bit == 0, dtype.type(m01.real), dtype.type(m10.real))
-                    keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
-                    if keep is not None:
-                        csr = one + keep * (csr - one)
-                        cpr = keep * cpr
-                    xr, xi = (csr * xr + cpr * pr, csr * xi + cpr * pi)
-                    continue
-                # coefficient planes: self = m00/m11, pair = m01/m10 by bit q
-                csr = jnp.where(bit == 0, dtype.type(m00.real), dtype.type(m11.real))
-                csi = jnp.where(bit == 0, dtype.type(m00.imag), dtype.type(m11.imag))
-                cpr = jnp.where(bit == 0, dtype.type(m01.real), dtype.type(m10.real))
-                cpi = jnp.where(bit == 0, dtype.type(m01.imag), dtype.type(m10.imag))
-                # fold controls into the coefficients (identity where the
-                # control pattern misses) -- cheaper than output blending
-                keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
-                if keep is not None:
-                    csr = one + keep * (csr - one)
-                    csi = keep * csi
-                    cpr = keep * cpr
-                    cpi = keep * cpi
-                xr, xi = (csr * xr - csi * xi + cpr * pr - cpi * pi,
-                          csr * xi + csi * xr + cpr * pi + cpi * pr)
-
-            elif op[0] == "parity":
-                _, qubits, controls, theta = op
-                sign_scalar = jnp.array(1, jnp.int32)
-                par = None
-                for q in qubits:
-                    if q >= tile_bits:
-                        gb = gbit(q)
-                        sign_scalar = sign_scalar * (1 - 2 * gb)
-                    else:
-                        b = _bit_mask(q, shape)
-                        par = b if par is None else par ^ b
-                sign = sign_scalar.astype(dtype)
-                if par is not None:
-                    sign = sign * (1 - 2 * par).astype(dtype)
-                c = dtype.type(math.cos(theta / 2))
-                s = dtype.type(math.sin(theta / 2))
-                fr = c * jnp.ones_like(sign)
-                fi = -s * sign
-                keep = _keep_factor(controls, (), tile_bits, shape, dtype, gbit)
-                if keep is not None:
-                    fr = one + keep * (fr - one)
-                    fi = keep * fi
-                xr, xi = (xr * fr - xi * fi, xr * fi + xi * fr)
-
-            elif op[0] == "swap":
-                _, q1, q2, controls, states = op
-                # amps where bits q1,q2 differ exchange with partner(^q1^q2)
-                p2r = _partner(_partner(xr, q1), q2)
-                p2i = _partner(_partner(xi, q1), q2)
-                differ = (_bit_mask(q1, shape) ^ _bit_mask(q2, shape)).astype(dtype)
-                keep = _keep_factor(controls, states, tile_bits, shape, dtype, gbit)
-                sel = differ if keep is None else differ * keep
-                xr = xr + sel * (p2r - xr)
-                xi = xi + sel * (p2i - xi)
-
-            elif op[0] in ("kraus1", "kraus2"):
-                # a whole 1- or 2-target channel in ONE pass: for each
-                # Kraus term apply K on the row qubit(s) and conj(K) on the
-                # column qubit(s) to a COPY of the registers, accumulate
-                # sign-weighted -- rho' = sum_k s_k K_k rho K_k^dagger with
-                # zero extra HBM traffic. The reference pays a dedicated
-                # kernel launch per channel (QuEST_gpu.cu:2423-2600) and,
-                # distributed, the 3-exchange two-qubit depolarising
-                # protocol (QuEST_cpu_distributed.c:778-868); round 2 paid
-                # ~2 passes per term.
-                if op[0] == "kraus1":
-                    _, t, c, terms = op
-                    apply_k = lambda r, i, K: mat2(*mat2(r, i, t, K),
-                                                   c, np.conj(K))
-                else:
-                    _, t1, t2, c1, c2, terms = op
-                    apply_k = lambda r, i, K: mat4(*mat4(r, i, t1, t2, K),
-                                                   c1, c2, np.conj(K))
-                acc_r = acc_i = None
-                for sign, K in terms:
-                    K = np.asarray(K.arr if hasattr(K, "arr") else K)
-                    yr, yi = apply_k(xr, xi, K)
-                    if sign != 1.0:
-                        yr = dtype.type(sign) * yr
-                        yi = dtype.type(sign) * yi
-                    acc_r = yr if acc_r is None else acc_r + yr
-                    acc_i = yi if acc_i is None else acc_i + yi
-                xr, xi = acc_r, acc_i
-
-            elif op[0] == "diagw":
-                _, targets, controls, D = op
-                d = np.asarray(D.arr if hasattr(D, "arr") else D).reshape(-1)
-                # table index: in-tile target bits come from iota masks,
-                # grid-bit targets from per-program scalars (broadcasts)
-                idx = None
-                for j, q in enumerate(targets):
-                    b = gbit(q) if q >= tile_bits else _bit_mask(q, shape)
-                    term = b << j
-                    idx = term if idx is None else idx + term
-                fr = jnp.full(shape, dtype.type(d[0].real))
-                fi = jnp.full(shape, dtype.type(d[0].imag))
-                for k in range(1, d.size):
-                    hit = idx == k
-                    fr = jnp.where(hit, dtype.type(d[k].real), fr)
-                    fi = jnp.where(hit, dtype.type(d[k].imag), fi)
-                keep = _keep_factor(controls, (), tile_bits, shape, dtype, gbit)
-                if keep is not None:
-                    fr = one + keep * (fr - one)
-                    fi = keep * fi
-                xr, xi = (xr * fr - xi * fi, xr * fi + xi * fr)
-
-            else:  # pragma: no cover
-                raise ValueError(f"unknown pallas op {op[0]!r}")
+        xr, xi = _ops_body(ops, xr, xi, tile_bits=tile_bits,
+                           dtype=dtype, gbit=gbit,
+                           get_w=lambda i: w_refs[i][:])
 
         if store_swap is not None:
             dk, s_low = store_swap
@@ -584,6 +599,118 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
         else:
             o_ref[0] = xr
             o_ref[1] = xi
+
+    return kernel
+
+
+def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
+                     nchunks: int, load_swap, store_swap):
+    """Manual double-buffered-DMA kernel: ONE pallas program owns the whole
+    pass, looping over the 2^grid chunks with explicit async copies --
+    next chunk's load and previous chunk's store overlap the current
+    chunk's compute. Measured vs the BlockSpec grid pipeline at 2^26 amps:
+    full-state copy 3.9 vs 6.3 ms (the BlockSpec pipeline leaves ~40% of
+    HBM bandwidth on the table; round-3 probe), which is most of the 26q
+    bench's per-pass floor.
+
+    ``load_swap``/``store_swap`` = (dk, s_low, gm_sz) fold the frame-swap
+    relabeling into the chunk DMAs: the operand arrives as the 7-D
+    bit-block-swap view (_swap_view) and each chunk load/store is one
+    strided descriptor gathering/scattering the dk sub-blocks."""
+
+    def kernel(x_hbm, *refs):
+        w_refs = refs[:-1]
+        o_hbm = refs[-1]
+
+        def body(ins, outs, rsem, wsem):
+            def chunk_coords(geo, c):
+                # decompose the chunk index against THIS DMA's swap
+                # geometry (load and store may use different k / hi)
+                dk, _, gm_sz = geo
+                gm = jax.lax.rem(c, gm_sz)
+                rest = jax.lax.div(c, gm_sz)
+                return (jax.lax.div(rest, dk), gm, jax.lax.rem(rest, dk))
+
+            def load_dma(slot, c):
+                if load_swap is None:
+                    return pltpu.make_async_copy(
+                        x_hbm.at[:, c], ins.at[slot], rsem.at[slot])
+                hi2, gm, dnew = chunk_coords(load_swap, c)
+                return pltpu.make_async_copy(
+                    x_hbm.at[:, hi2, :, gm, dnew], ins.at[slot],
+                    rsem.at[slot])
+
+            def store_dma(slot, c):
+                if store_swap is None:
+                    return pltpu.make_async_copy(
+                        outs.at[slot], o_hbm.at[:, c], wsem.at[slot])
+                hi2, gm, dnew = chunk_coords(store_swap, c)
+                return pltpu.make_async_copy(
+                    outs.at[slot], o_hbm.at[:, hi2, :, gm, dnew],
+                    wsem.at[slot])
+
+            load_dma(0, 0).start()
+
+            def gbit_for(c):
+                def gbit(q):
+                    return (c >> (q - tile_bits)) & 1
+                return gbit
+
+            def loop(c, carry):
+                slot = jax.lax.rem(c, 2)
+                nxt = jax.lax.rem(c + 1, 2)
+
+                @pl.when(c + 1 < nchunks)
+                def _():
+                    load_dma(nxt, c + 1).start()
+
+                load_dma(slot, c).wait()
+                if load_swap is not None:
+                    dk, s_low, _ = load_swap
+                    xr = ins[slot, 0].reshape(dk * s_low, _LANES)
+                    xi = ins[slot, 1].reshape(dk * s_low, _LANES)
+                else:
+                    xr = ins[slot, 0]
+                    xi = ins[slot, 1]
+                xr, xi = _ops_body(ops, xr, xi, tile_bits=tile_bits,
+                                   dtype=dtype, gbit=gbit_for(c),
+                                   get_w=lambda i: w_refs[i][:])
+
+                @pl.when(c >= 2)
+                def _():
+                    store_dma(slot, c - 2).wait()
+
+                if store_swap is not None:
+                    dk, s_low, _ = store_swap
+                    outs[slot, 0] = xr.reshape(dk, s_low, _LANES)
+                    outs[slot, 1] = xi.reshape(dk, s_low, _LANES)
+                else:
+                    outs[slot, 0] = xr
+                    outs[slot, 1] = xi
+                store_dma(slot, c).start()
+                return carry
+
+            jax.lax.fori_loop(0, nchunks, loop, 0)
+            for c in range(max(0, nchunks - 2), nchunks):
+                store_dma(c % 2, c).wait()
+
+        if load_swap is not None:
+            dk, s_low, _ = load_swap
+            in_shape = (2, dk, s_low, _LANES)
+        else:
+            in_shape = (2, s, _LANES)
+        if store_swap is not None:
+            dk, s_low, _ = store_swap
+            out_shape = (2, dk, s_low, _LANES)
+        else:
+            out_shape = (2, s, _LANES)
+        pl.run_scoped(
+            body,
+            ins=pltpu.VMEM((2,) + in_shape, dtype),
+            outs=pltpu.VMEM((2,) + out_shape, dtype),
+            rsem=pltpu.SemaphoreType.DMA((2,)),
+            wsem=pltpu.SemaphoreType.DMA((2,)),
+        )
 
     return kernel
 
@@ -728,17 +855,52 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
                           np.asarray(o[3].arr if hasattr(o[3], "arr") else o[3])))
         else:
             ops_r.append(o)
+    x = amps.reshape(2, rows, _LANES)
+    lo2_load = (load_swap_hi if load_swap_hi is not None else tile_bits)
+    lo2_store = (store_swap_hi if store_swap_hi is not None else tile_bits)
+
+    if local_n is None and grid > 1:
+        # manual double-buffered-DMA kernel (see _make_dma_kernel): one
+        # program, explicit chunk pipeline -- ~40% more HBM bandwidth than
+        # the BlockSpec grid pipeline on this geometry. Runs under the
+        # interpreter too, so CI covers the production path; only the
+        # per-shard (shard_map) path keeps the grid kernel.
+        def swap_geo(k, lo2):
+            if not k:
+                return None
+            return (1 << k, s >> k, 1 << (lo2 - LANE_BITS - s_bits))
+
+        lsw = swap_geo(load_swap_k, lo2_load)
+        ssw = swap_geo(store_swap_k, lo2_store)
+        x_in = (_swap_view(x, rows, s, lo2_load - LANE_BITS, load_swap_k)
+                if load_swap_k else x.reshape(2, grid, s, _LANES))
+        if store_swap_k:
+            oshape = _swap_view(x, rows, s, lo2_store - LANE_BITS,
+                                store_swap_k).shape
+        else:
+            oshape = (2, grid, s, _LANES)
+        kernel = _make_dma_kernel(tuple(ops_r), s, tile_bits,
+                                  np.dtype(amps.dtype), grid, lsw, ssw)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(oshape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] +
+                     [pl.BlockSpec(memory_space=pltpu.VMEM) for _ in ws],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=interpret,
+        )(x_in, *ws)
+        return out.reshape(2, -1)
+
     kernel = _make_kernel(
         tuple(ops_r), s_bits, tile_bits, np.dtype(amps.dtype),
         local_n=local_n,
         load_swap=(1 << load_swap_k, s >> load_swap_k) if load_swap_k else None,
         store_swap=(1 << store_swap_k, s >> store_swap_k) if store_swap_k else None)
 
-    x = amps.reshape(2, rows, _LANES)
     plain = pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
                          memory_space=pltpu.VMEM)
-    lo2_load = (load_swap_hi if load_swap_hi is not None else tile_bits)
-    lo2_store = (store_swap_hi if store_swap_hi is not None else tile_bits)
     if load_swap_k:
         x_in = _swap_view(x, rows, s, lo2_load - LANE_BITS, load_swap_k)
         in_spec0 = _swap_spec(s, lo2_load - LANE_BITS, load_swap_k)
