@@ -1061,7 +1061,8 @@ def _apply_dense_block(qureg, U: np.ndarray, qubits: tuple) -> None:
         ev = GateEvent("matrix", tuple(qubits), matrix=U)
         lane_U = event_matrix(ev, tuple(range(PG.LANE_BITS)))
         ur, ui = lane_U.real, lane_U.imag
-        W = np.block([[ur.T, ui.T], [-ui.T, ur.T]])
+        # Karatsuba operand stack, matching the kernel's lane_u format
+        W = np.stack([ur.T, ui.T, ur.T + ui.T])
         amps = PG.fused_local_run(
             qureg.amps, n=nsv, ops=(("lane_u", PG.HashableMatrix(W)),))
         qureg.put(amps)

@@ -248,6 +248,51 @@ def test_folded_production_path_19q():
                                atol=TOL, rtol=TOL)
 
 
+def test_lane_fold_on_grid_kernel_path():
+    """A folded lane run (Karatsuba (3,128,128) operand) through the
+    grid-kernel path (grid == 1), which carries explicit w BlockSpecs --
+    the operand rank must match the index map (regression: the 2-index
+    map of the old 256x256 format crashed on the 3-D stack)."""
+    n = 10
+    amps = ops_init.init_debug(1 << n, real_dtype())
+    # >2.2ms-equivalent of lane butterflies forces the lane fold
+    ops = tuple(("matrix", q % 7, (), (), PG.HashableMatrix(H))
+                for q in range(25))
+    got = PG.fused_local_run(amps + 0, n=n, ops=ops, sublanes=8)
+    folded = PG._fold_zone_ops(ops, PG.local_qubits(n, 8))
+    assert any(o[0] == "lane_u" for o in folded), "fold did not trigger"
+
+    circ = Circuit(n)
+    for q in range(25):
+        circ.hadamard(q % 7)
+    ref = circ.as_fn()(ops_init.init_debug(1 << n, real_dtype()))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=TOL, rtol=TOL)
+
+
+def test_folded_swap_asymmetric_geometries():
+    """load and store swaps with DIFFERENT k / hi in one pass (the DMA
+    kernel decomposes chunk indices per-DMA; a shared decomposition would
+    scatter amplitudes to wrong slots)."""
+    n = 13
+    rng = np.random.default_rng(9)
+    base = np.asarray(rng.normal(size=(2, 1 << n)), dtype=real_dtype())
+    ops = (("matrix", 0, (), (), PG.HashableMatrix(H)),)
+    tb = 10  # sublanes=8: grid bits 10..12
+
+    import jax.numpy as jnp
+    def sw(a, k, hi):
+        return PG.swap_bit_blocks(a + 0, n=n, lo1=tb - k, lo2=hi, k=k)
+    run = lambda a, **kw: PG.fused_local_run(jnp.asarray(a) + 0, n=n,
+                                             ops=ops, sublanes=8,
+                                             interpret=True, **kw)
+    # load k=1 at hi=12, store k=2 at hi=10 (default tile boundary)
+    got = run(base, load_swap_k=1, load_swap_hi=12, store_swap_k=2)
+    ref = sw(run(sw(jnp.asarray(base), 1, 12)), 2, tb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=TOL, rtol=TOL)
+
+
 def test_folded_plan_agrees_end_to_end():
     """A plan whose runs carry folded frame swaps replays to the same
     amplitudes as the unfused circuit (the executor maps the annotations
