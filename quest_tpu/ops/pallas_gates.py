@@ -69,18 +69,65 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE_BITS = 7          # minor dim fixed at 128 lanes
 _LANES = 1 << LANE_BITS
-#: (2, 2048, 128) f32 tile = 2 MiB. Swept on the 26q bench: S=1024 -> 2604
-#: gates/s, S=2048 -> 2699, S=4096 -> 2432; larger tiles amortise per-program
-#: DMA overhead until block size outgrows the pipeline. Needs the raised
-#: Mosaic VMEM limit in _fused_local_run (the 16 MiB default OOMs).
-_DEF_SUBLANES = 1 << 11
+#: (2, 4096, 128) f32 tile = 4 MiB. Round-4 re-sweep of the manual-DMA
+#: kernel's chunk size at 2^26 amps (tools/kernelprobe, min-of-3): the
+#: per-PASS floor is per-chunk-overhead-bound at the old S=2048 default
+#: (256 chunks, 11.2 ms) and drops to ~7.7 ms at S=4096; S=8192 is flat
+#: within noise (7.5) but its 32 MiB of double-buffers plus op
+#: temporaries overflow the 100 MiB Mosaic VMEM stack on op-heavy runs
+#: (measured OOM at 24 mixed ops). S=4096 also raises local_qubits by
+#: one over round 3 -- more in-tile targets per fused run.
+_DEF_SUBLANES = 1 << 12
 
 #: matmul precision for the in-kernel zone dots (lane_u / window). Mosaic
 #: lowers only DEFAULT and HIGHEST (Precision.HIGH raises
 #: NotImplementedError, probed round 3); HIGHEST keeps the 26q depth-8
 #: norm drift at ~1.4e-5 after 7 circuits vs DEFAULT's ~8e-5 per circuit
-#: (BASELINE.md precision table) -- the only acceptable setting.
+#: (BASELINE.md precision table). f32 tiles take the manual bf16x3 route
+#: below instead; this setting remains for the f64-interpreter path.
 _DOT_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def _split_bf16(w: np.ndarray):
+    """Host-side hi/lo bf16 decomposition of an f32 operand matrix:
+    w ~= hi + lo with hi = bf16(w) and lo = bf16(w - hi). Stacked on a new
+    leading axis so the pair ships as ONE kernel operand."""
+    import ml_dtypes
+
+    hi = w.astype(ml_dtypes.bfloat16)
+    lo = (w - hi.astype(np.float32)).astype(ml_dtypes.bfloat16)
+    return np.stack([hi, lo])
+
+
+def _dot_bf16x3(x, w_pair, dtype):
+    """x @ W at ~f32 accuracy from THREE DEFAULT-precision bf16 MXU passes.
+
+    Mosaic's HIGHEST lowers an f32 dot to SIX bf16 passes (full 3x3 hi/lo
+    cross terms); the manual split keeps the three leading terms
+    (hi*hi + hi*lo + lo*hi), whose dropped lo*lo term is O(2^-16) relative
+    -- measured norm drift ~1e-6/circuit on the 26q depth-8 bench vs
+    HIGHEST's 1.4e-5/7-circuits budget (BASELINE.md precision table).
+    Halves the MXU time of every zone dot: the lane dots are the
+    serialized compute that bounds the 26q bench (round-3 floor
+    analysis). ``w_pair`` = (2, ...) stacked bf16 hi/lo from _split_bf16."""
+    xh = x.astype(jnp.bfloat16)
+    xl = (x - xh.astype(dtype)).astype(jnp.bfloat16)
+    wh, wl = w_pair[0], w_pair[1]
+    acc = jnp.dot(xh, wh, preferred_element_type=dtype)
+    acc += jnp.dot(xh, wl, preferred_element_type=dtype)
+    acc += jnp.dot(xl, wh, preferred_element_type=dtype)
+    return acc
+
+
+def _dot_bf16x3_rev(w_pair, y, dtype):
+    """W @ y variant of _dot_bf16x3 (static matrix on the LEFT)."""
+    yh = y.astype(jnp.bfloat16)
+    yl = (y - yh.astype(dtype)).astype(jnp.bfloat16)
+    wh, wl = w_pair[0], w_pair[1]
+    acc = jnp.dot(wh, yh, preferred_element_type=dtype)
+    acc += jnp.dot(wl, yh, preferred_element_type=dtype)
+    acc += jnp.dot(wh, yl, preferred_element_type=dtype)
+    return acc
 
 
 def local_qubits(n: int, sublanes: int = _DEF_SUBLANES) -> int:
@@ -169,6 +216,27 @@ def _op_event(op):
     return GateEvent("parity", tuple(op[1]), tuple(op[2]), theta=float(op[3]))
 
 
+def op_dense_targets(op) -> tuple:
+    """Qubits on which ``op`` needs a DENSE (partner-exchanging) action --
+    the ones that must sit below the tile/shard limit. Diagonal roles
+    (controls, parity members, diagw/grid-diagonal targets) are excluded:
+    they resolve per-program/per-shard. The ONE authoritative extraction
+    for the legality checks in fused_local_run and
+    fusion._run_pallas_sharded."""
+    if op[0] == "matrix":
+        m = op[4].arr if hasattr(op[4], "arr") else op[4]
+        if complex(m[0][1]) == 0 and complex(m[1][0]) == 0:
+            return ()
+        return (op[1],)
+    if op[0] in ("swap", "kraus1"):
+        return (op[1], op[2])
+    if op[0] == "kraus2":
+        return tuple(op[1:5])
+    if op[0] == "krausn":
+        return (*op[1], *op[2])
+    return ()  # parity / diagw / lane_u / window: no dense roles above tile
+
+
 def _op_support(op):
     if op[0] == "matrix":
         return {op[1], *op[2]}
@@ -176,7 +244,7 @@ def _op_support(op):
         return {op[1], op[2], *(op[3] if op[0] == "swap" else ())}
     if op[0] == "kraus2":
         return {op[1], op[2], op[3], op[4]}
-    if op[0] in ("diagw", "parity"):
+    if op[0] in ("diagw", "parity", "krausn"):
         return {*op[1], *op[2]}
     return set(range(LANE_BITS))  # lane_u acts on the lane zone
 
@@ -190,31 +258,37 @@ def _op_is_diag(op):
     return False
 
 
-#: estimated per-op kernel cost in ms at 2^26 amps f32 (round-3 microbench,
-#: after the slice-butterfly rewrite of _partner). Only the RATIOS matter:
-#: the fold decision compares accumulated butterfly cost against the zone's
-#: dense-dot cost on the same scale.
-_FOLD_LANE_DOT_MS = 2.2     # lane_u: 3 Karatsuba 128x128 HIGHEST dots
-_FOLD_WINDOW_DOT_MS = 1.0   # sublane window: per-slab (2D,2D) dots
+#: estimated per-op kernel cost in ms at 2^26 amps f32 (round-4
+#: kernelprobe slopes at the S=8192 default, min-of-3 methodology). Only
+#: the RATIOS matter: the fold decision compares accumulated butterfly
+#: cost against the zone's dense-dot cost on the same scale. The round-3
+#: model had these backwards (lane butterflies cheap, dots expensive);
+#: with bf16x3 dots and the 8192-row tile, a lane butterfly (two
+#: cross-lane rolls + selects over the whole tile) costs MORE than the
+#: whole folded lane dot, so the lane zone folds from the first dense
+#: gate, while sublane slice-butterflies stay cheaper than the per-slab
+#: window dots until a zone accumulates several of them.
+_FOLD_LANE_DOT_MS = 0.47    # lane_u: 3 Karatsuba bf16x3 dot triples
+_FOLD_WINDOW_DOT_MS = 0.87  # sublane window: per-slab (2D,2D) dots
 
 
 def _op_cost_ms(op) -> float:
     """Estimated in-kernel cost of one un-folded op (see table above):
-    diagonals are ~free; lane butterflies and m>=8 sublane slice
-    butterflies are cheap; small-m sublane butterflies (q=7,8,9) pay
-    sub-sublane-tile relayouts."""
+    diagonals are ~free; sublane slice butterflies are cheap (the low-m
+    ones especially); lane butterflies pay cross-lane rolls over the
+    whole tile."""
     if _op_is_diag(op):
-        return 0.02
+        return 0.01
     def tcost(q):
         if q < LANE_BITS:
-            return 0.1
+            return 0.76
         m = q - LANE_BITS
-        return (1.3, 0.45, 0.25)[m] if m < 3 else 0.08
+        return 0.07 if m < 3 else 0.25
     if op[0] == "matrix":
         return tcost(op[1])
     if op[0] == "swap":
         return tcost(op[1]) + tcost(op[2])
-    # kraus1 never reaches this model: zone_of() bars it from accumulators
+    # kraus ops never reach this model: zone_of() bars them from accumulators
     return 0.02
 
 
@@ -235,10 +309,11 @@ def _fold_zone_ops(ops, tile_bits: int) -> tuple:
     This is the dense-fusion economics of quest_tpu/fusion.py applied
     inside the kernel, with a COST MODEL deciding each flush: a zone folds
     only when the estimated cost of its accumulated butterflies
-    (_op_cost_ms) exceeds the zone's dense-dot cost. After the round-3
-    slice-butterfly rewrite most butterflies are nearly free, so folding
-    pays mainly in the [7,12) zone (whose q=7..9 butterflies pay
-    sub-sublane-tile relayouts) and for long lane runs."""
+    (_op_cost_ms) exceeds the zone's dense-dot cost. Under the round-4
+    measurements (bf16x3 dots, S=8192 tiles) lane butterflies cost more
+    than the whole folded lane dot -- the lane zone folds from the first
+    dense gate -- while sublane slice-butterflies stay cheaper than the
+    window dots until a zone accumulates several of them."""
     from ..fusion import event_matrix
 
     zones = [(0, LANE_BITS)]
@@ -251,7 +326,7 @@ def _fold_zone_ops(ops, tile_bits: int) -> tuple:
     accum = {z: [] for z in zones}   # zone -> [op]
 
     def zone_of(op):
-        if op[0] in ("kraus1", "kraus2"):
+        if op[0] in ("kraus1", "kraus2", "krausn"):
             return None  # non-unitary: must never enter a zone's dense fold
         s = _op_support(op)
         for z in zones:
@@ -345,31 +420,38 @@ def _ops_body(ops, xr, xi, *, tile_bits, dtype, gbit, get_w):
         return (csr * xr - csi * xi + cpr * pr - cpi * pi,
                 csr * xi + csi * xr + cpr * pi + cpi * pr)
 
-    def mat4(xr, xi, q1, q2, M):
-        """Uncontrolled 4x4 on in-tile qubit pair (q1 low bit, q2 high bit
-        of the matrix index). Row r = the element's own (q1, q2) bits;
+    def matn(xr, xi, qs, M):
+        """Uncontrolled 2^t x 2^t on in-tile qubits ``qs`` (qs[j] is bit j
+        of the matrix index). Row r = the element's own target bits;
         out[i] = sum_delta M[r, r^delta] * amp[i ^ delta] -- one partner
-        set per delta, coefficients selected per element by r."""
+        set per delta (built incrementally, one butterfly per new bit),
+        coefficients selected per element by r. Generalises the reference's
+        multiQubitUnitary local kernel (QuEST_cpu.c:1846-1912) to any
+        in-tile target set; used per-term by the kraus channel ops."""
+        t = len(qs)
         shape = xr.shape
-        b1 = _bit_mask(q1, shape)
-        b2 = _bit_mask(q2, shape)
-        r = b1 + 2 * b2
-        p1 = (_partner(xr, q1), _partner(xi, q1))
-        p2 = (_partner(xr, q2), _partner(xi, q2))
-        p12 = (_partner(p2[0], q1), _partner(p2[1], q1))
-        srcs = {0: (xr, xi), 1: p1, 2: p2, 3: p12}
+        r = None
+        for j, q in enumerate(qs):
+            term = _bit_mask(q, shape) << j
+            r = term if r is None else r + term
+        ps = {0: (xr, xi)}
+        for delta in range(1, 1 << t):
+            low = delta & -delta
+            j = low.bit_length() - 1
+            pr, pi = ps[delta ^ low]
+            ps[delta] = (_partner(pr, qs[j]), _partner(pi, qs[j]))
         acc_r = acc_i = None
-        for delta in range(4):
-            cvals = [complex(M[row, row ^ delta]) for row in range(4)]
+        for delta in range(1 << t):
+            cvals = [complex(M[row, row ^ delta]) for row in range(1 << t)]
             if all(v == 0 for v in cvals):
                 continue
             cr = jnp.full(shape, dtype.type(cvals[0].real))
             ci = jnp.full(shape, dtype.type(cvals[0].imag))
-            for row in range(1, 4):
+            for row in range(1, 1 << t):
                 hit = r == row
                 cr = jnp.where(hit, dtype.type(cvals[row].real), cr)
                 ci = jnp.where(hit, dtype.type(cvals[row].imag), ci)
-            sr, si = srcs[delta]
+            sr, si = ps[delta]
             tr = cr * sr - ci * si
             ti = cr * si + ci * sr
             acc_r = tr if acc_r is None else acc_r + tr
@@ -378,16 +460,24 @@ def _ops_body(ops, xr, xi, *, tile_bits, dtype, gbit, get_w):
         return (zero if acc_r is None else acc_r,
                 zero if acc_i is None else acc_i)
 
+    def mat4(xr, xi, q1, q2, M):
+        return matn(xr, xi, (q1, q2), M)
+
     shape = xr.shape
     for op in ops:
         if op[0] == "lane_u":
             W3 = get_w(op[1])              # (3, 128, 128): Ur^T, Ui^T, sum
-            p1 = jnp.dot(xr, W3[0], preferred_element_type=xr.dtype,
-                         precision=_DOT_PRECISION)
-            p2 = jnp.dot(xi, W3[1], preferred_element_type=xi.dtype,
-                         precision=_DOT_PRECISION)
-            p3 = jnp.dot(xr + xi, W3[2], preferred_element_type=xr.dtype,
-                         precision=_DOT_PRECISION)
+            if W3.dtype == jnp.bfloat16:   # (2, 3, 128, 128) hi/lo pair
+                p1 = _dot_bf16x3(xr, W3[:, 0], dtype)
+                p2 = _dot_bf16x3(xi, W3[:, 1], dtype)
+                p3 = _dot_bf16x3(xr + xi, W3[:, 2], dtype)
+            else:
+                p1 = jnp.dot(xr, W3[0], preferred_element_type=xr.dtype,
+                             precision=_DOT_PRECISION)
+                p2 = jnp.dot(xi, W3[1], preferred_element_type=xi.dtype,
+                             precision=_DOT_PRECISION)
+                p3 = jnp.dot(xr + xi, W3[2], preferred_element_type=xr.dtype,
+                             precision=_DOT_PRECISION)
             xr = p1 - p2
             xi = p3 - p1 - p2
 
@@ -405,8 +495,11 @@ def _ops_body(ops, xr, xi, *, tile_bits, dtype, gbit, get_w):
             outs_r, outs_i = [], []
             for a in range(a_cnt):
                 y = jnp.concatenate([xr4[a], xi4[a]], axis=0)
-                o = jnp.dot(W, y, preferred_element_type=y.dtype,
-                            precision=_DOT_PRECISION)
+                if W.dtype == jnp.bfloat16:  # (2, 2D, 2D) hi/lo pair
+                    o = _dot_bf16x3_rev(W, y, dtype)
+                else:
+                    o = jnp.dot(W, y, preferred_element_type=y.dtype,
+                                precision=_DOT_PRECISION)
                 outs_r.append(o[:d])
                 outs_i.append(o[d:])
             xr = jnp.concatenate(outs_r, axis=0).reshape(shape)
@@ -496,8 +589,8 @@ def _ops_body(ops, xr, xi, *, tile_bits, dtype, gbit, get_w):
             xr = xr + sel * (p2r - xr)
             xi = xi + sel * (p2i - xi)
 
-        elif op[0] in ("kraus1", "kraus2"):
-            # a whole 1- or 2-target channel in ONE pass: for each
+        elif op[0] in ("kraus1", "kraus2", "krausn"):
+            # a whole 1-, 2- or t-target channel in ONE pass: for each
             # Kraus term apply K on the row qubit(s) and conj(K) on the
             # column qubit(s) to a COPY of the registers, accumulate
             # sign-weighted -- rho' = sum_k s_k K_k rho K_k^dagger with
@@ -505,15 +598,21 @@ def _ops_body(ops, xr, xi, *, tile_bits, dtype, gbit, get_w):
             # kernel launch per channel (QuEST_gpu.cu:2423-2600) and,
             # distributed, the 3-exchange two-qubit depolarising
             # protocol (QuEST_cpu_distributed.c:778-868); round 2 paid
-            # ~2 passes per term.
+            # ~2 passes per term. The >=3-target form routes every
+            # backend through one mechanism, like the reference's
+            # superoperator treatment (QuEST_common.c:581-638).
             if op[0] == "kraus1":
                 _, t, c, terms = op
                 apply_k = lambda r, i, K: mat2(*mat2(r, i, t, K),
                                                c, np.conj(K))
-            else:
+            elif op[0] == "kraus2":
                 _, t1, t2, c1, c2, terms = op
                 apply_k = lambda r, i, K: mat4(*mat4(r, i, t1, t2, K),
                                                c1, c2, np.conj(K))
+            else:
+                _, rows_q, cols_q, terms = op
+                apply_k = lambda r, i, K: matn(*matn(r, i, rows_q, K),
+                                               cols_q, np.conj(K))
             acc_r = acc_i = None
             for sign, K in terms:
                 K = np.asarray(K.arr if hasattr(K, "arr") else K)
@@ -760,20 +859,13 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
     if (load_swap_k or store_swap_k) and shard_index is not None:
         raise ValueError("folded frame swaps cannot run per-shard")
 
-    def _is_diag_matrix(o):
-        m = o[4].arr if hasattr(o[4], "arr") else o[4]
-        return complex(m[0][1]) == 0 and complex(m[1][0]) == 0
-
     lq = local_qubits(n, sublanes)
     for o in ops:
-        if o[0] == "matrix" and o[1] >= lq and not _is_diag_matrix(o):
+        bad = [q for q in op_dense_targets(o) if q >= lq]
+        if bad:
             raise ValueError(
-                f"non-diagonal matrix target {o[1]} >= local_qubits({n}, "
+                f"{o[0]} dense target(s) {bad} >= local_qubits({n}, "
                 f"{sublanes}) = {lq}; route wide targets via ops.apply")
-        if o[0] in ("swap", "kraus1") and (o[1] >= lq or o[2] >= lq):
-            raise ValueError(f"{o[0]} targets {o[1:3]} must be < {lq}")
-        if o[0] == "kraus2" and any(q >= lq for q in o[1:5]):
-            raise ValueError(f"kraus2 targets {o[1:5]} must be < {lq}")
     if shard_index is None:
         shard_index = jnp.zeros((1,), jnp.int32)
         local_n = None
@@ -849,13 +941,22 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
     # their op entries carry the operand index instead of the matrix
     ws = []
     ops_r = []
+    # f32 tiles ship the zone matrices as bf16 hi/lo pairs (the bf16x3
+    # three-DEFAULT-pass dot, half of HIGHEST's six); f64 keeps full-width
+    # operands for the interpreter/engine path
+    bf16x3 = np.dtype(amps.dtype) == np.dtype("float32")
+
+    def ship(w):
+        w = np.asarray(w, dtype=np.float32 if bf16x3 else amps.dtype)
+        return jnp.asarray(_split_bf16(w) if bf16x3 else w)
+
     for o in ops:
         if o[0] == "lane_u":
             ops_r.append(("lane_u", len(ws)))
-            ws.append(jnp.asarray(np.asarray(o[1].arr.real, dtype=amps.dtype)))
+            ws.append(ship(o[1].arr.real))
         elif o[0] == "window":
             ops_r.append(("window", len(ws), o[1], o[2]))
-            ws.append(jnp.asarray(np.asarray(o[3].arr.real, dtype=amps.dtype)))
+            ws.append(ship(o[3].arr.real))
         elif o[0] == "matrix":
             ops_r.append((o[0], o[1], o[2], o[3],
                           np.asarray(o[4].arr if hasattr(o[4], "arr") else o[4])))

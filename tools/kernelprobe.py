@@ -1,0 +1,118 @@
+"""Component-level microbench of the fused-run kernel at 2^26 amps.
+
+Round-4 findings this tool exists to nail down (single-shot timings on the
+tunnelled chip drift by several ms, so every config is timed 3x and the MIN
+reported; per-op costs come from the SLOPE between a x4 and x16 op-count
+run, not from subtracting separately-measured floors):
+
+  1. the per-pass floor vs DMA chunk size S (the 2048 default = 256 chunks
+     at 2^26; per-chunk overhead may dominate the floor),
+  2. the true marginal cost of un-folded butterfly ops (the fold cost
+     model's _op_cost_ms),
+  3. the bf16x3 zone-dot costs (lane_u, window) the fold thresholds
+     compare against.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def sync(a):
+    return float(jax.device_get(a.reshape(-1)[0]))
+
+
+def timeit(fn, amps, label, reps=10, trials=3):
+    @jax.jit
+    def looped(x):
+        for _ in range(reps):
+            x = fn(x)
+        return x
+
+    amps = looped(amps)
+    sync(amps)
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        amps = looped(amps)
+        sync(amps)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    print(f"{label:30s} {best * 1e3:8.3f} ms")
+    return amps, best
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 26
+    from quest_tpu.ops import pallas_gates as PG
+    from quest_tpu.ops.pallas_gates import HashableMatrix, fused_local_run
+
+    rng = np.random.RandomState(0)
+
+    def ru(d=2):
+        q, _ = np.linalg.qr(rng.randn(d, d) + 1j * rng.randn(d, d))
+        return q
+
+    H = HashableMatrix(np.array([[1, 1], [1, -1]]) / np.sqrt(2))
+    T = HashableMatrix(np.diag([1, np.exp(1j * np.pi / 4)]))
+    amps = jnp.zeros((2, 1 << n), jnp.float32).at[0, 0].set(1.0)
+    print(f"n={n}  backend={jax.default_backend()}")
+
+    def run(ops, **kw):
+        ops = tuple(ops)
+        return lambda x: fused_local_run(x, n=n, ops=ops, **kw)
+
+    # --- per-pass floor vs chunk size -----------------------------------
+    for s in (2048, 4096, 8192, 16384):
+        amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=s),
+                         amps, f"floor S={s}")
+
+    # --- folded-swap DMA overheads (at the default S) -------------------
+    amps, _ = timeit(run([("matrix", 0, (), (), T)], load_swap_k=8),
+                     amps, "ld=8 S=2048")
+    amps, _ = timeit(run([("matrix", 0, (), (), T)], load_swap_k=8,
+                         store_swap_k=8), amps, "ld=8 st=8 S=2048")
+    amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=8192,
+                         load_swap_k=6), amps, "ld=6 S=8192")
+    amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=8192,
+                         load_swap_k=6, store_swap_k=6),
+                     amps, "ld=6 st=6 S=8192")
+
+    # --- per-op slopes: x4 vs x16 of one kind ---------------------------
+    def slope(label, mk, **kw):
+        nonlocal amps
+        o4 = [mk(i) for i in range(4)]
+        o16 = [mk(i) for i in range(16)]
+        amps, t4 = timeit(run(o4, **kw), amps, f"{label} x4")
+        amps, t16 = timeit(run(o16, **kw), amps, f"{label} x16")
+        print(f"{'':30s} -> {1e3 * (t16 - t4) / 12:8.3f} ms/op slope")
+
+    slope("lane butterfly H", lambda i: ("matrix", i % 7, (), (), H))
+    slope("sublane q7-9 H", lambda i: ("matrix", 7 + i % 3, (), (), H))
+    slope("sublane q10+ H", lambda i: ("matrix", 10 + i % 8, (), (), H))
+    slope("diag T", lambda i: ("matrix", i % 18, (), (), T))
+    W3 = [HashableMatrix(np.stack([ru(128).real.T, ru(128).real.T,
+                                   ru(128).real.T])) for _ in range(16)]
+    slope("lane_u bf16x3", lambda i: ("lane_u", W3[i]))
+    W5 = []
+    for _ in range(16):
+        u32 = ru(32)
+        W5.append(HashableMatrix(np.block([[u32.real, -u32.imag],
+                                           [u32.imag, u32.real]])))
+    slope("window span5 lo7", lambda i: ("window", 7, 5, W5[i]))
+    slope("window span5 lo12", lambda i: ("window", 12, 5, W5[i]))
+
+
+if __name__ == "__main__":
+    main()

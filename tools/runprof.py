@@ -16,12 +16,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 
 def sync(a):
     return float(jax.device_get(a.reshape(-1)[0]))
 
 
-def timeit(fn, amps, reps=20):
+def timeit(fn, amps, reps=10):
     @jax.jit
     def looped(x):
         for _ in range(reps):
@@ -58,20 +62,24 @@ def main():
             folded = _fold_zone_ops(item.ops, tb)
             comp = Counter(o[0] for o in folded)
             lk, sk = item.load_swap_k, item.store_swap_k
+            lh, sh = item.load_swap_hi, item.store_swap_hi
             # same foldability guard as fusion._apply_pallas_run: profile
             # what production actually runs (explicit swaps otherwise)
             if max(lk, sk) and tb - LANE_BITS - max(lk, sk) < 3:
-                def run(x, ops=item.ops, lk=lk, sk=sk):
+                def run(x, ops=item.ops, lk=lk, sk=sk, lh=lh, sh=sh):
                     if lk:
-                        x = swap_bit_blocks(x, n=n, lo1=tb - lk, lo2=tb, k=lk)
+                        x = swap_bit_blocks(x, n=n, lo1=tb - lk,
+                                            lo2=tb if lh is None else lh, k=lk)
                     x = fused_local_run(x, n=n, ops=ops)
                     if sk:
-                        x = swap_bit_blocks(x, n=n, lo1=tb - sk, lo2=tb, k=sk)
+                        x = swap_bit_blocks(x, n=n, lo1=tb - sk,
+                                            lo2=tb if sh is None else sh, k=sk)
                     return x
             else:
-                def run(x, ops=item.ops, lk=lk, sk=sk):
+                def run(x, ops=item.ops, lk=lk, sk=sk, lh=lh, sh=sh):
                     return fused_local_run(x, n=n, ops=ops,
-                                           load_swap_k=lk, store_swap_k=sk)
+                                           load_swap_k=lk, store_swap_k=sk,
+                                           load_swap_hi=lh, store_swap_hi=sh)
             dt, amps = timeit(run, amps)
             print(f"[{i:2d}] run  {dt*1e3:7.3f} ms  {len(item.ops):3d} ops "
                   f"ld={lk} st={sk} -> {dict(comp)}")
