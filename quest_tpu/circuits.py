@@ -80,20 +80,28 @@ def _resolve(name):
 
 #: modules whose tape entries route EVERY amps access through the explicit
 #: scheduler's coordinate remapping -- safe to run under a deferred layout
-_DEFER_SAFE_MODULES = ("quest_tpu.gates", "quest_tpu.decoherence")
+_DEFER_SAFE_MODULES = ("quest_tpu.gates", "quest_tpu.decoherence",
+                       "quest_tpu.operators")
+
+#: operators-module entries that DO read/write raw full-state amplitude
+#: order (a full 2^N diagonal indexed by flat position; a wholesale state
+#: overwrite) -- these still force reconciliation
+_DEFER_BARRIER_NAMES = {"applyDiagonalOp", "setQuregToPauliHamil"}
 
 
 def _defer_safe(f) -> bool:
     """True if tape entry ``f`` may run while the scheduler's deferred
-    qubit layout is non-identity. Gate and channel entries remap their
-    coordinates through the scheduler; fused dense/diag blocks route
-    through the same gate primitives. Everything else (inits, phase
-    functions, operators acting on raw amplitude order, Pallas runs and
-    frame swaps) assumes the identity layout and forces reconciliation."""
+    qubit layout is non-identity. Gate, channel and operator entries remap
+    their coordinates through the scheduler (phase functions, projectors
+    and sub-diagonal ops are pure index algebra -- remapping is
+    scheduler.map_diagonal_qubits; matrixN routes through apply_matrix);
+    fused dense/diag blocks route through the same gate primitives.
+    Everything else (inits, full-state diagonals, Pallas runs and frame
+    swaps) assumes the identity layout and forces reconciliation."""
     from . import fusion
 
     if getattr(f, "__module__", None) in _DEFER_SAFE_MODULES:
-        return True
+        return getattr(f, "__name__", "") not in _DEFER_BARRIER_NAMES
     return f is fusion._apply_dense_block
 
 
@@ -123,7 +131,7 @@ def _tape_accesses(tape, num_qubits, is_density, dtype):
             out.append(frozenset(qs))
             continue
         events = fusion.capture(f, args, kwargs, num_qubits, dtype,
-                                is_density=is_density)
+                                is_density=is_density, aux=True)
         if events is None:
             out.append(None)
             continue
@@ -308,6 +316,7 @@ class Circuit:
         from .precision import real_dtype
 
         tile_bits = None
+        shard_boundary = None
         if pallas:
             from .ops.pallas_gates import LANE_BITS, local_qubits
             # density tapes plan over the flattened 2n-qubit state: the
@@ -321,14 +330,26 @@ class Circuit:
                         f"shard_devices must be a power of 2 (got {d}); "
                         "amplitude sharding splits whole top qubits")
                 n_eff -= d.bit_length() - 1
+                # align frame blocks to the shard boundary: frames below
+                # it relabel with shard-LOCAL transposes (no collective)
+                shard_boundary = n_eff
             # below 2^LANE_BITS amplitudes there is no lane tile to build;
             # the ordinary fusion path handles such registers
             if n_eff > LANE_BITS:
                 tile_bits = local_qubits(n_eff)
-        p = fusion.plan(tuple(self._tape), self.num_qubits,
-                        np.dtype(dtype) if dtype else real_dtype(),
-                        max_qubits=max_qubits, pallas_tile_bits=tile_bits,
-                        is_density=self.is_density_matrix)
+        dt = np.dtype(dtype) if dtype else real_dtype()
+        if tile_bits is not None and shard_boundary is not None:
+            # sharded: try plain and boundary-aligned frame tilings, keep
+            # the one with fewer collective transposes
+            p = fusion.plan_pallas_sharded(
+                tuple(self._tape), self.num_qubits, dt, max_qubits,
+                tile_bits, shard_boundary,
+                is_density=self.is_density_matrix)
+        else:
+            p = fusion.plan(tuple(self._tape), self.num_qubits, dt,
+                            max_qubits=max_qubits,
+                            pallas_tile_bits=tile_bits,
+                            is_density=self.is_density_matrix)
         out = Circuit(self.num_qubits, self.is_density_matrix)
         out._tape = fusion.as_tape(p)
         return out

@@ -18,6 +18,7 @@ from .datatypes import (DiagonalOp, PauliHamil, SubDiagonalOp,
                         pauli_term_matrix, phaseFunc)
 from .ops import apply as K, cplx, diagonal as D, measure as M
 from .ops import phasefunc as PF, reduce as R
+from .parallel import scheduler as _dist
 from .registers import Qureg, createCloneQureg
 
 __all__ = [
@@ -48,11 +49,16 @@ def _record(qureg, text):
 # ---------------------------------------------------------------------------
 
 def _apply_matrix_left(qureg: Qureg, matrix, targets, controls=()):
-    """M|psi> or M.rho (left multiplication only)."""
+    """M|psi> or M.rho (left multiplication only). Routes through the
+    explicit scheduler when one is active, so the entry both shows in plan
+    stats and remaps its coordinates under a deferred layout (round-4:
+    operator entries no longer force deferral reconciliation)."""
     nsv = qureg.num_qubits_in_state_vec
     m = cplx.from_complex(matrix, qureg.dtype)
-    qureg.put(K.apply_matrix(qureg.amps, m, n=nsv, targets=tuple(targets),
-                             controls=tuple(controls)))
+    sched = _dist.active()
+    apply_m = sched.apply_matrix if sched is not None else K.apply_matrix
+    qureg.put(apply_m(qureg.amps, m, n=nsv, targets=tuple(targets),
+                      controls=tuple(controls)))
 
 
 def _apply_matrix_gate(qureg: Qureg, matrix, targets, controls=()):
@@ -60,12 +66,14 @@ def _apply_matrix_gate(qureg: Qureg, matrix, targets, controls=()):
     n = qureg.num_qubits_represented
     nsv = qureg.num_qubits_in_state_vec
     m = cplx.from_complex(matrix, qureg.dtype)
-    amps = K.apply_matrix(qureg.amps, m, n=nsv, targets=tuple(targets),
-                          controls=tuple(controls))
+    sched = _dist.active()
+    apply_m = sched.apply_matrix if sched is not None else K.apply_matrix
+    amps = apply_m(qureg.amps, m, n=nsv, targets=tuple(targets),
+                   controls=tuple(controls))
     if qureg.is_density_matrix:
-        amps = K.apply_matrix(amps, m, n=nsv,
-                              targets=tuple(q + n for q in targets),
-                              controls=tuple(c + n for c in controls), conj=True)
+        amps = apply_m(amps, m, n=nsv,
+                       targets=tuple(q + n for q in targets),
+                       controls=tuple(c + n for c in controls), conj=True)
     qureg.put(amps)
 
 
@@ -279,9 +287,15 @@ def applyProjector(qureg: Qureg, target: int, outcome: int) -> None:
     V.validate_outcome(outcome, func)
     n = qureg.num_qubits_represented
     nsv = qureg.num_qubits_in_state_vec
-    amps = M.project_statevec(qureg.amps, n=nsv, target=target, outcome=outcome)
+    sched = _dist.active()
+    t_row, t_col = target, target + n
+    if sched is not None:  # projection is diagonal: remap, never reconcile
+        (t_row,) = sched.map_diagonal_qubits(nsv, (t_row,))
+    amps = M.project_statevec(qureg.amps, n=nsv, target=t_row, outcome=outcome)
     if qureg.is_density_matrix:
-        amps = M.project_statevec(amps, n=nsv, target=target + n, outcome=outcome)
+        if sched is not None:
+            (t_col,) = sched.map_diagonal_qubits(nsv, (t_col,))
+        amps = M.project_statevec(amps, n=nsv, target=t_col, outcome=outcome)
     qureg.put(amps)
     _record(qureg, f"applyProjector({outcome}) on q[{target}]")
 
@@ -314,11 +328,19 @@ def _phase_func_apply(qureg, qubits_flat, reg_sizes, encoding, coeffs, exponents
     coeffs_d = jnp.asarray(np.asarray(coeffs, dtype=np.float64), dtype=dt)
     ovr_i = jnp.asarray(np.asarray(override_inds, dtype=np.float64), dtype=dt)
     ovr_p = jnp.asarray(np.asarray(override_phases, dtype=np.float64), dtype=dt)
+    # phase functions are pure index algebra over their qubits: under the
+    # explicit scheduler they remap to physical coordinates (comm-free in
+    # any deferred layout) instead of forcing reconciliation
+    sched = _dist.active()
+    row = tuple(int(q) for q in qubits_flat)
+    if sched is not None:
+        row = sched.map_diagonal_qubits(nsv, row)
     amps = PF.apply_poly_phase(qureg.amps, coeffs_d, ovr_i, ovr_p,
-                               n=nsv, qubits=tuple(int(q) for q in qubits_flat),
-                               conj=False, **args)
+                               n=nsv, qubits=row, conj=False, **args)
     if qureg.is_density_matrix:
         shifted = tuple(int(q) + n for q in qubits_flat)
+        if sched is not None:
+            shifted = sched.map_diagonal_qubits(nsv, shifted)
         amps = PF.apply_poly_phase(amps, coeffs_d, ovr_i, ovr_p,
                                    n=nsv, qubits=shifted, conj=True, **args)
     qureg.put(amps)
@@ -427,11 +449,16 @@ def applyParamNamedPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_
     args = dict(reg_sizes=tuple(reg_sizes), encoding=int(encoding),
                 func_name=int(func_name), num_params=len(params),
                 num_overrides=n_ovr)
+    sched = _dist.active()
+    row = tuple(int(q) for q in qubits_flat)
+    if sched is not None:
+        row = sched.map_diagonal_qubits(nsv, row)
     amps = PF.apply_named_phase(qureg.amps, params_d, ovr_i, ovr_p,
-                                n=nsv, qubits=tuple(int(q) for q in qubits_flat),
-                                conj=False, **args)
+                                n=nsv, qubits=row, conj=False, **args)
     if qureg.is_density_matrix:
         shifted = tuple(int(q) + n for q in qubits_flat)
+        if sched is not None:
+            shifted = sched.map_diagonal_qubits(nsv, shifted)
         amps = PF.apply_named_phase(amps, params_d, ovr_i, ovr_p,
                                     n=nsv, qubits=shifted, conj=True, **args)
     qureg.put(amps)
@@ -568,8 +595,10 @@ def applySubDiagonalOp(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
     V._assert(op.num_qubits == len(targets),
               "The diagonal operator must act upon the same number of qubits as specified.", func)
     d = cplx.from_complex(np.asarray(op.elems), qureg.dtype)
-    qureg.put(D.apply_diagonal(qureg.amps, d, n=qureg.num_qubits_in_state_vec,
-                               targets=tuple(targets)))
+    sched = _dist.active()
+    apply_d = sched.apply_diagonal if sched is not None else D.apply_diagonal
+    qureg.put(apply_d(qureg.amps, d, n=qureg.num_qubits_in_state_vec,
+                      targets=tuple(targets)))
     _record(qureg, "applySubDiagonalOp")
 
 
@@ -582,9 +611,11 @@ def applyGateSubDiagonalOp(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
     n = qureg.num_qubits_represented
     nsv = qureg.num_qubits_in_state_vec
     d = cplx.from_complex(np.asarray(op.elems), qureg.dtype)
-    amps = D.apply_diagonal(qureg.amps, d, n=nsv, targets=tuple(targets))
+    sched = _dist.active()
+    apply_d = sched.apply_diagonal if sched is not None else D.apply_diagonal
+    amps = apply_d(qureg.amps, d, n=nsv, targets=tuple(targets))
     if qureg.is_density_matrix:
-        amps = D.apply_diagonal(amps, d, n=nsv,
-                                targets=tuple(q + n for q in targets), conj=True)
+        amps = apply_d(amps, d, n=nsv,
+                       targets=tuple(q + n for q in targets), conj=True)
     qureg.put(amps)
     _record(qureg, "applyGateSubDiagonalOp")

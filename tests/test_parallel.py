@@ -166,6 +166,80 @@ def test_deferred_relocation_beats_reference_policy_on_bench_circuit():
     assert deferred["pair_exchanges"] == 0  # nothing uses the 2-chunk path
 
 
+def test_deferred_survives_mixed_tape_with_qft_and_phase_funcs():
+    """VERDICT r3 next #8 'done' criterion: operator entries (QFT, named
+    phase functions, projectors, matrixN) remap their coordinates through
+    the scheduler instead of forcing reconciliation, so deferral keeps
+    >= 30% of its comm win on realistic mixed tapes."""
+    import numpy as np
+
+    from __graft_entry__ import _random_layers
+    from quest_tpu.datatypes import phaseFunc
+    from quest_tpu.parallel.scheduler import comm_chunks
+
+    n = 34
+    circ = qt.Circuit(n)
+    _random_layers(circ, n, 3)
+    # interleave non-gate entries that used to be deferral barriers
+    circ.applyQFT(list(range(n - 6, n)))          # gates on sharded qubits
+    _random_layers(circ, n, 2)
+    circ.applyNamedPhaseFunc([0, 1, 2, n - 1], [4], 0, phaseFunc.NORM)
+    circ.applyPhaseFunc([2, n - 2], 0, [0.5], [2.0])
+    circ.applyProjector(n - 1, 0)
+    circ.applyMatrixN([0, 1], np.kron(np.eye(2), np.diag([1, 1j])))
+    _random_layers(circ, n, 3)
+
+    deferred = plan_circuit(circ, ENV.mesh)
+    immediate = plan_circuit(circ, ENV.mesh, defer=False)
+    assert comm_chunks(deferred) <= 0.7 * comm_chunks(immediate), \
+        (deferred, immediate)
+    # the operator entries themselves planned comm-free
+    assert deferred["comm_free"] >= 4
+
+
+def test_operator_entries_execute_correctly_under_deferred_layout():
+    """Remapped operator entries (phase funcs, projector, matrixN, sub-
+    diagonal, QFT) must produce IDENTICAL amplitudes when replayed while
+    the deferred layout is non-identity (qubits physically permuted)."""
+    from quest_tpu.datatypes import createSubDiagonalOp, phaseFunc
+
+    n = 5
+    nl = local_qubit_count(n, ENV.mesh)
+    sub = createSubDiagonalOp(1)
+    sub.elems[:] = [1.0, 1j]
+
+    circ = qt.Circuit(n)
+    circ.hadamard(n - 1)              # sharded: relocates, layout now permuted
+    circ.hadamard(nl)                 # second displacement
+    circ.applyPhaseFunc([0, n - 1], 0, [0.3], [2.0])
+    circ.applyNamedPhaseFunc([1, n - 1], [2], 0, phaseFunc.NORM)
+    circ.applyQFT([0, 1, n - 1])
+    circ.applyMatrixN([n - 1], np.diag([1.0, 1j]))
+    circ.applySubDiagonalOp([n - 2], sub)
+    circ.applyProjector(n - 1, 0)
+    circ.hadamard(0)
+
+    q_ref = qt.createQureg(n, ENV)
+    qt.initPlusState(q_ref)
+    for f, a, kw in circ._tape:
+        f(q_ref, *a, **kw)
+
+    # the plan really defers across the operator entries: displacements
+    # stay outstanding (reconciled only at replay end) while the operator
+    # entries run comm-free on the permuted layout
+    stats = plan_circuit(circ, ENV.mesh)
+    assert stats["relocation_swaps"] >= 1
+    assert stats["reconcile_swaps"] >= 1
+    assert stats["comm_free"] >= 5
+
+    q = qt.createQureg(n, ENV)
+    qt.initPlusState(q)
+    with qt.explicit_mesh(ENV.mesh):
+        circ.run(q)
+
+    np.testing.assert_allclose(qt.get_np(q), qt.get_np(q_ref), atol=TOL)
+
+
 def test_measurement_under_explicit_mesh():
     """Eager measurement composes with the explicit context (host RNG +
     collapse run outside shard_map)."""
