@@ -40,9 +40,15 @@ def init(coordinator_address: str | None = None,
     On Cloud TPU pods all three arguments auto-detect; elsewhere pass them
     explicitly or via JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
     JAX_PROCESS_ID, exactly like mpirun's rank/size but resolved by the
-    JAX distributed runtime instead of an MPI launcher."""
-    if jax.process_count() > 1:
-        return  # already initialised
+    JAX distributed runtime instead of an MPI launcher.
+
+    Must run before anything touches the XLA backend (jax.distributed's
+    own contract) -- so the already-initialised check goes through
+    jax.distributed.is_initialized(), NOT jax.process_count(), which
+    would itself initialise the backend (found by the round-4 2-process
+    smoke test, tests/test_multihost.py)."""
+    if jax.distributed.is_initialized():
+        return
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None and num_processes is None:
