@@ -39,10 +39,14 @@ import numpy as np
 REF_GATES_PER_SEC = {20: 422.99, 24: 23.42, 26: 5.86}
 
 #: reference QuEST 14q density channel-ops/sec on this host (same circuit,
-#: tools/ref_bench.c --density 14; measured 2026-07-30, 1-core -O3
-#: -DMULTITHREADED=1 build -- kernels timed: densmatr_mixDepolarisingLocal
-#: QuEST_cpu.c:137-185 and the mixKrausMap superoperator path)
-REF_DENSITY_CHANNEL_OPS_PER_SEC = {14: 0.93}
+#: tools/ref_bench.c --density 14 5; re-measured 2026-07-31 after the
+#: round-4 addition of the 3-target mixMultiQubitKrausMap to the circuit
+#: (the 6-qubit superoperator pass dominates the reference's step; the
+#: 10-op round-3 circuit anchored at 0.93). 1-core -O3 -DMULTITHREADED=1
+#: build -- kernels timed: densmatr_mixDepolarisingLocal
+#: QuEST_cpu.c:137-185 and the all-arity Kraus superoperator path
+#: QuEST_common.c:581-638.
+REF_DENSITY_CHANNEL_OPS_PER_SEC = {14: 0.20}
 
 
 def build_circuit(n: int, depth: int):
@@ -68,9 +72,13 @@ def bench_density(n: int, reps: int, sync) -> dict:
 
     k = 1 / np.sqrt(2)
     kraus = [np.array([[k, 0], [0, k]]), np.array([[0, k], [k, 0]])]
-    # representative channel step: unitaries + both decoherence families.
+    # representative channel step: unitaries + both decoherence families +
+    # a 3-target Kraus map (rides the round-4 'krausn' one-pass kernel op).
     # Kept lean: a 14q density register is 2^28 amps and each Kraus channel
     # lowers to several full passes, so op count drives remote-compile time.
+    xxx = np.kron(np.kron([[0, 1], [1, 0]], [[0, 1], [1, 0]]),
+                  [[0, 1], [1, 0]])
+    kraus3 = [0.8 * xxx, 0.6j * np.eye(8)]  # CPTP: 0.64 I + 0.36 I
     circ = Circuit(n, is_density_matrix=True)
     for q in range(4):
         circ.hadamard(q)
@@ -80,6 +88,7 @@ def bench_density(n: int, reps: int, sync) -> dict:
     circ.mixDepolarising(n - 1, 0.05)
     circ.mixKrausMap(1, kraus)
     circ.mixTwoQubitDephasing(0, 1, 0.1)
+    circ.mixMultiQubitKrausMap([2, 3, 4], kraus3)
     num_ops = len(circ)
     # pallas=True: the unitary prefix rides fused kernel runs with explicit
     # conj-shadow ops (round-3 density fast path); channels stay barriers
@@ -120,6 +129,9 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
     # runs measure tunnel jitter
     if n < 22:
         reps *= 4
+    # chain 2 circuit applications per program at 22-25q: one ~6.5 ms
+    # tunnel dispatch per ~20-40 ms circuit is a measurable tax there
+    inner = 4 if n < 22 else (2 if n < 26 else 1)
     # two-frame pallas from 20q up: with frame swaps folded into the run
     # DMA (round 3) the fused kernel wins well below the HBM-resident
     # sizes (20q measured 96k gates/s pallas vs 31k XLA same-session);
@@ -129,14 +141,13 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
           file=sys.stderr)
     if len(fused) > 48:
         fn = fused.compiled_blocks(max_gates=24, donate=True)
-    elif n < 22:
-        # sub-3ms circuits are dispatch-bound through the axon tunnel:
-        # chain INNER applications inside one program (the loop-inside-jit
-        # methodology of tools/microbench.py) so the timed region measures
-        # device work, not per-dispatch overhead
+    elif inner > 1:
+        # dispatch-bound circuits (sub-3ms outright below 22q; a ~15%
+        # tunnel-dispatch tax at 22-25q): chain INNER applications inside
+        # one program (the loop-inside-jit methodology of
+        # tools/microbench.py) so the timed region measures device work
         import jax
 
-        inner = 4
         base = fused.as_fn()
 
         def chained(amps):

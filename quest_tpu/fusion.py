@@ -530,10 +530,15 @@ def _lower_event(ev: GateEvent):
 
 
 #: max kernel primitive ops per emitted PallasRun (pre-fold); splitting a
-#: longer run costs one extra HBM pass but keeps Mosaic compile time sane
-#: (round-4 compile matrix at 2^26: 24 ops 16 s, 48 ops 112 s, 96 ops
-#: 737 s -- strongly superlinear)
-_RUN_OP_CAP = 48
+#: longer run costs one extra HBM pass (the bench circuit's 8-pass
+#: structural floor is worth more than compile time: capping at 48 split
+#: it to 10 passes and cost ~4% of throughput), but the cap must exist:
+#: Mosaic compile time is strongly superlinear in op count (round-4
+#: matrix at 2^26: 24 ops 16 s, 48 ops 112 s, 96 ops 737 s) and a 20q
+#: mono-kernel at 316 ops ran past 20 minutes. 96 covers the bench's
+#: largest natural run; the persistent compilation cache amortises the
+#: one-time cost.
+_RUN_OP_CAP = 96
 
 
 class _FramePlanner:

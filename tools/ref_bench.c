@@ -55,10 +55,10 @@ static long apply_layers(Qureg q, int n, int depth) {
 
 /* The density-channel anchor: the same circuit as bench.py's
  * bench_density (4x H + 2x CNOT + 2x mixDepolarising + mixKrausMap +
- * mixTwoQubitDephasing = 10 channel ops per rep), timed through the
- * reference's own density kernels (densmatr_mixDepolarisingLocal,
- * QuEST_cpu.c:137-185; mixKrausMap via the 2t-qubit superoperator,
- * QuEST_common.c:581-638). */
+ * mixTwoQubitDephasing + a 3-target mixMultiQubitKrausMap = 11 channel
+ * ops per rep), timed through the reference's own density kernels
+ * (densmatr_mixDepolarisingLocal, QuEST_cpu.c:137-185; Kraus maps of
+ * every arity via the 2t-qubit superoperator, QuEST_common.c:581-638). */
 static long apply_density_step(Qureg rho, int n) {
     qreal k = 0.70710678118654752440;
     ComplexMatrix2 kraus[2] = {
@@ -72,7 +72,21 @@ static long apply_density_step(Qureg rho, int n) {
     mixDepolarising(rho, n - 1, 0.05);
     mixKrausMap(rho, 1, kraus, 2);
     mixTwoQubitDephasing(rho, 0, 1, 0.1);
-    return 10;
+    /* 3-target Kraus map: K0 = 0.8 XXX, K1 = 0.6i I (CPTP:
+     * 0.64 I + 0.36 I = I) */
+    {
+        int targs[3] = {2, 3, 4};
+        ComplexMatrixN ks[2] = {createComplexMatrixN(3),
+                                createComplexMatrixN(3)};
+        for (int r = 0; r < 8; r++) {
+            ks[0].real[r][7 - r] = 0.8;
+            ks[1].imag[r][r] = 0.6;
+        }
+        mixMultiQubitKrausMap(rho, targs, 3, ks, 2);
+        destroyComplexMatrixN(ks[0]);
+        destroyComplexMatrixN(ks[1]);
+    }
+    return 11;
 }
 
 static int main_density(int n, int reps) {
