@@ -35,8 +35,9 @@ import time
 
 import numpy as np
 
-#: reference QuEST gates/sec on this host (see module docstring)
-REF_GATES_PER_SEC = {20: 422.99, 24: 23.42, 26: 5.86}
+#: reference QuEST gates/sec on this host (see module docstring; 28q
+#: measured 2026-07-31, 1 rep of the depth-8 circuit = ~10.5 min)
+REF_GATES_PER_SEC = {20: 422.99, 24: 23.42, 26: 5.86, 28: 0.54}
 
 #: reference QuEST 14q density channel-ops/sec on this host (same circuit,
 #: tools/ref_bench.c --density 14 5; re-measured 2026-07-31 after the

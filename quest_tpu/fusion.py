@@ -542,11 +542,9 @@ _RUN_OP_CAP = 96
 
 
 class _FramePlanner:
-    """Greedy multi-frame scheduler: maintains the currently-open run and
-    one lookahead run in another frame. Appending to the open run requires
-    commuting past every lookahead op (the open run executes first); when
-    neither run can take an op, the open run is emitted (with a frame swap
-    if needed) and the lookahead becomes open.
+    """Greedy multi-frame scheduler over an ordered list of pending runs
+    (see the Scheduling paragraph below; the eager two-slot variant lives
+    in _FramePlannerTwoSlot).
 
     A *frame* is a qubit relabeling: ``None`` is the identity; ``(hi, kf)``
     means the grid-bit block [hi, hi+kf) is swapped with the sublane block
@@ -555,7 +553,17 @@ class _FramePlanner:
     register is in-tile in some frame -- the round-4 generalisation that
     lets a sharded 34q register execute fused PallasRuns per shard with
     each frame switch one (collective) transpose (VERDICT r3 missing #1).
-    """
+
+    Scheduling (round-4b): an ordered list of PENDING runs, each pinned
+    to a frame. A new op joins the EARLIEST run whose frame localises it
+    and whose every LATER pending op commutes past it (runs execute in
+    list order; an op placed in run i runs before everything in runs
+    j > i, so it must commute with what is already there -- and later
+    arrivals into runs j < i check against it symmetrically). Ops that
+    fit nowhere open a new run. Holding every run open until flush lets
+    late ops join early runs, which cuts frame alternations well below
+    the two-slot (open + one lookahead) round-4a scheme on >=3-frame
+    plans (34q sharded, density tapes)."""
 
     def __init__(self, out: FusePlan, tile_bits: int, k: int, nsv: int,
                  boundary: int | None = None):
@@ -577,8 +585,7 @@ class _FramePlanner:
                 self.frames.append((hi, min(k, hi_edge - hi)))
                 hi += k
         self.cur_frame = None        # physical frame of the amps stream
-        self.open = [None, []]       # [frame, [_POp]]
-        self.next = [Ellipsis, []]   # Ellipsis = frame not yet chosen
+        self.runs = []               # ordered pending [frame, [_POp]]
 
     # -- frame geometry -----------------------------------------------------
 
@@ -665,6 +672,50 @@ class _FramePlanner:
             return ("diagw", t, c, HashableMatrix(op.data))
         return ("parity", t, c, op.data)
 
+    def flush(self):
+        """Emit every pending run in order and return to the identity."""
+        for frame, ops in self.runs:
+            self._emit_run(frame, ops)
+        self._leave_cur_frame()
+        self.runs = []
+
+    # -- scheduling ---------------------------------------------------------
+
+    def add(self, op: _POp):
+        # earliest run that localises the op AND whose every later op
+        # commutes past it (see class docstring for the ordering argument)
+        for i, (frame, ops) in enumerate(self.runs):
+            if not self.feasible(op, frame):
+                continue
+            if all(self._commutes(op, other)
+                   for _, later in self.runs[i + 1:] for other in later):
+                ops.append(op)
+                return
+        f = self._frame_for(op, exclude=Ellipsis)
+        if f is Ellipsis:  # pragma: no cover - callers pre-check
+            raise AssertionError("op feasible in no frame reached the scheduler")
+        self.runs.append([f, [op]])
+
+    @staticmethod
+    def _commutes(a: _POp, b: _POp) -> bool:
+        return all(a.diag_on(q) and b.diag_on(q)
+                   for q in a.support & b.support)
+
+
+class _FramePlannerTwoSlot(_FramePlanner):
+    """The round-4a two-slot variant: one OPEN run plus one lookahead run,
+    rotated eagerly when an op fits neither. Kept alongside the ordered-
+    list scheduler because neither dominates: eager rotation balances
+    two-frame tapes better (26q bench: 8 raw runs vs the list's 9, whose
+    first run absorbs 153 ops and then pays an op-cap split), while the
+    list wins on >=3-frame plans (34q sharded: 14 passes vs 42).
+    _plan_pallas schedules with both and keeps the cheaper plan."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.open = [None, []]       # [frame, [_POp]]
+        self.next = [Ellipsis, []]   # Ellipsis = frame not yet chosen
+
     def rotate(self):
         frame, ops = self.open
         self._emit_run(frame, ops)
@@ -674,15 +725,12 @@ class _FramePlanner:
         self.next = [Ellipsis, []]
 
     def flush(self):
-        """Emit both pending runs and return the amps to the identity."""
         self._emit_run(*self.open)
         if self.next[0] is not Ellipsis:
             self._emit_run(*self.next)
         self._leave_cur_frame()
         self.open = [None, []]
         self.next = [Ellipsis, []]
-
-    # -- scheduling ---------------------------------------------------------
 
     def add(self, op: _POp):
         for _ in range(3):
@@ -704,11 +752,6 @@ class _FramePlanner:
             self.rotate()
         raise AssertionError(  # pragma: no cover
             "op feasible in no frame reached the scheduler")
-
-    @staticmethod
-    def _commutes(a: _POp, b: _POp) -> bool:
-        return all(a.diag_on(q) and b.diag_on(q)
-                   for q in a.support & b.support)
 
 
 def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
@@ -900,7 +943,8 @@ def plan_pallas_sharded(tape, num_qubits: int, dtype, max_qubits: int,
         boundaries.append(n_local)
     cands = [
         _plan_pallas(tape, num_qubits, dtype, max_qubits, tile_bits,
-                     is_density=is_density, shard_boundary=b)
+                     is_density=is_density, shard_boundary=b,
+                     score_shard_qubits=n_local)
         for b in boundaries
     ]
     return min(cands, key=lambda p: (
@@ -909,20 +953,30 @@ def plan_pallas_sharded(tape, num_qubits: int, dtype, max_qubits: int,
 
 def _plan_pallas(tape, num_qubits: int, dtype, max_qubits: int,
                  tile_bits: int, is_density: bool = False,
-                 shard_boundary: int | None = None) -> FusePlan:
-    """Two-frame Pallas plan: lower every event to kernel primitive ops and
-    schedule them across alternating qubit frames (see _FramePlanner).
-    Density tapes (``is_density``) plan over the flattened 2n-qubit state:
-    every lowered row op is paired with its conj-shadow twin and both are
+                 shard_boundary: int | None = None,
+                 score_shard_qubits: int | None = None) -> FusePlan:
+    """Multi-frame Pallas plan: lower every event to kernel primitive ops
+    (ONE spy-capture pass over the tape -- the dominant trace-time cost),
+    then schedule the lowered stream with BOTH frame schedulers (the
+    ordered-list _FramePlanner and the two-slot variant) and keep the
+    cheaper plan: fewer passes single-chip, fewer collective transposes
+    first when ``score_shard_qubits`` is set. Density tapes
+    (``is_density``) plan over the flattened 2n-qubit state: every
+    lowered row op is paired with its conj-shadow twin and both are
     scheduled; the emitted PallasRuns then carry EXPLICIT shadow ops, and
     every execution path applies them raw (no shadow re-derivation)."""
     from .ops.pallas_gates import LANE_BITS
 
     nsv = (2 if is_density else 1) * num_qubits
-    out = FusePlan()
     k = min(max(nsv - tile_bits, 0), tile_bits - LANE_BITS)
-    sched = _FramePlanner(out, tile_bits, k, nsv, boundary=shard_boundary)
 
+    def make_planner(cls):
+        return cls(FusePlan(), tile_bits, k, nsv, boundary=shard_boundary)
+
+    probe = make_planner(_FramePlanner)  # frame geometry only
+
+    # -- pass 1: resolve every tape entry (capture + lower + routability) --
+    resolved = []  # ('barrier', entry) | ('events', [(ev, pops|None)])
     for fn, args, kwargs in tape:
         events = capture(fn, args, kwargs, num_qubits, dtype,
                          is_density=is_density)
@@ -937,11 +991,13 @@ def _plan_pallas(tape, num_qubits: int, dtype, max_qubits: int,
                     if pops is not None and is_density and not ev.extended:
                         pops = [q for p in pops
                                 for q in (p, _shadow_pop(p, num_qubits))]
+                if pops is not None and not all(
+                        probe.feasible_somewhere(p) for p in pops):
+                    pops = None  # a target no frame localises
                 lowered.append(pops)
 
             def routable(ev, pops):
-                if (pops is not None
-                        and all(sched.feasible_somewhere(p) for p in pops)):
+                if pops is not None:
                     return True
                 # dense window fallback -- unitary events only (a channel
                 # has no dense 2^w x 2^w unitary to fall back to)
@@ -952,25 +1008,45 @@ def _plan_pallas(tape, num_qubits: int, dtype, max_qubits: int,
                        for ev, pops in zip(events, lowered)):
                 events = None  # no route for some event: run the entry as-is
         if events is None:
-            sched.flush()
-            out.items.append((fn, args, kwargs))
-            out.num_barriers += 1
-            continue
-        for ev, pops in zip(events, lowered):
-            if pops is not None and all(sched.feasible_somewhere(p) for p in pops):
-                for p in pops:
-                    sched.add(p)
-            else:
-                # dense multi-qubit matrix (or a target no frame localises):
-                # standalone window block through the engine, identity frame
-                # (FusedBlock stays in ROW coordinates; _apply_dense_block
-                # re-derives the density shadow itself)
+            resolved.append(("barrier", (fn, args, kwargs)))
+        else:
+            resolved.append(("events", list(zip(events, lowered))))
+
+    # -- pass 2: schedule with each planner, keep the cheaper plan --------
+    def schedule(cls):
+        sched = make_planner(cls)
+        out = sched.out
+        for kind, payload in resolved:
+            if kind == "barrier":
                 sched.flush()
-                win = _window(ev.support)
-                out.items.append(FusedBlock(win, event_matrix(ev, win)))
-            out.num_fused_gates += 1
-    sched.flush()
-    return out
+                out.items.append(payload)
+                out.num_barriers += 1
+                continue
+            for ev, pops in payload:
+                if pops is not None:
+                    for p in pops:
+                        sched.add(p)
+                else:
+                    # dense multi-qubit matrix (or a target no frame
+                    # localises): standalone window block through the
+                    # engine, identity frame (FusedBlock stays in ROW
+                    # coordinates; _apply_dense_block re-derives the
+                    # density shadow itself)
+                    sched.flush()
+                    win = _window(ev.support)
+                    out.items.append(FusedBlock(win, event_matrix(ev, win)))
+                out.num_fused_gates += 1
+        sched.flush()
+        return out
+
+    def score(p):
+        st = transpose_stats(p, score_shard_qubits)
+        if score_shard_qubits is not None:
+            return (st["collective_transposes"], len(p.items))
+        return (len(p.items), st["local_transposes"])
+
+    return min((schedule(cls)
+                for cls in (_FramePlanner, _FramePlannerTwoSlot)), key=score)
 
 
 import threading

@@ -220,6 +220,73 @@ def test_three_target_channel_rides_krausn_kernel_op():
     assert abs(qt.calcTotalProb(rho) - 1.0) < TOL
 
 
+def test_non_tp_three_target_channel_rides_krausn():
+    """Non-trace-preserving 3-target maps lower to krausn too (their
+    Kraus-sum superoperator is still CP, so all Choi terms carry +1);
+    replay must match the eager engine."""
+    n = 5
+    rng = np.random.RandomState(3)
+    k0 = 0.5 * (rng.randn(8, 8) + 1j * rng.randn(8, 8))
+
+    c = Circuit(n, is_density_matrix=True)
+    c.hadamard(0)
+    c.controlledNot(0, 2)
+    c.mixNonTPMultiQubitKrausMap([0, 2, 4], [k0])
+    fz = c.fused(max_qubits=4, pallas=True)
+    kn = [op for f, a, _ in fz._tape
+          if f.__name__ == "_apply_pallas_run" for op in a[0]
+          if op[0] == "krausn"]
+    assert len(kn) == 1
+
+    env = qt.createQuESTEnv()
+    rho = qt.createDensityQureg(n, env)
+    qt.initPlusState(rho)
+    ref = qt.createDensityQureg(n, env)
+    qt.initPlusState(ref)
+    fz.run(rho)
+    for f, a, kw in c._tape:
+        f(ref, *a, **kw)
+    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
+                               atol=TOL, rtol=TOL)
+
+
+def test_krausn_signed_terms_kernel_matches_engine():
+    """The krausn op's SIGNED accumulation (sum_k s_k K_k rho K_k^dagger
+    with s_k = -1 terms, produced by the Choi decomposition of a genuinely
+    non-CP superoperator): the fused kernel and the engine replay of the
+    SAME signed term list must agree. No public API yields a non-CP
+    superoperator (Kraus sums are CP by construction), so this drives the
+    kernel op directly."""
+    import jax.numpy as jnp
+
+    from quest_tpu import fusion
+    from quest_tpu.ops import cplx
+    from quest_tpu.ops import apply as K
+    from quest_tpu.ops.density import _acc_kraus_term
+
+    n = 4  # flattened: 8 qubits
+    rng = np.random.RandomState(9)
+    g = rng.randn(8, 8) + 1j * rng.randn(8, 8)
+    u8, _ = np.linalg.qr(g)
+    terms = ((1.0, PG.HashableMatrix(0.9 * u8)),
+             (-1.0, PG.HashableMatrix(0.4 * np.eye(8))))
+    rows, cols = (0, 1, 2), (n, n + 1, n + 2)
+    op = ("krausn", rows, cols, terms)
+
+    amps = ops_init.init_debug(1 << (2 * n), real_dtype())
+    got = np.asarray(PG.fused_local_run(amps + 0, n=2 * n, ops=(op,),
+                                        sublanes=2, interpret=True))
+
+    # engine oracle: per-term row/col applications, sign-accumulated
+    out = None
+    for sign, kk in terms:
+        km = cplx.from_complex(np.asarray(kk.arr), amps.dtype)
+        y = K.apply_matrix(amps + 0, km, n=2 * n, targets=rows)
+        y = K.apply_matrix(y, km, n=2 * n, targets=cols, conj=True)
+        out = _acc_kraus_term(out, sign, y)
+    np.testing.assert_allclose(got, np.asarray(out), atol=TOL, rtol=TOL)
+
+
 def test_density_pallas_with_frame_swaps_matches_oracle():
     """Density planning where column qubits exceed the tile: shadow ops on
     grid bits force frame swaps; amplitudes must match the eager engine."""
