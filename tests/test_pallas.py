@@ -15,7 +15,7 @@ from quest_tpu.ops import init as ops_init
 from quest_tpu.ops import pallas_gates as PG
 from quest_tpu.precision import real_dtype
 
-from .helpers import TOL
+from .helpers import TOL, assert_amps_close
 
 H = np.array([[1, 1], [1, -1]]) / np.sqrt(2)
 X = np.array([[0, 1], [1, 0]], dtype=complex)
@@ -49,8 +49,8 @@ def test_kernel_matches_engine_all_bit_classes():
     circ.multiStateControlledUnitary([7], [0], 5, H)
     circ.multiRotateZ([0, 9], 0.77)
     circ.hadamard(7)
-    ref = circ.as_fn()(ops_init.init_debug(1 << n, real_dtype()))
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL, rtol=TOL)
+    ref = np.asarray(circ.as_fn()(ops_init.init_debug(1 << n, real_dtype())))
+    assert_amps_close(np.asarray(got), ref)
 
 
 def test_bf16x3_zone_dots_f32_numerics():
@@ -109,8 +109,7 @@ def test_pallas_integrated_fusion_agrees(seed):
     assert any(f.__name__ == "_apply_pallas_run" for f, _, _ in fz._tape)
 
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
-    np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(fz.as_fn()(mk())), np.asarray(circ.as_fn()(mk())))
 
 
 def test_density_tapes_ride_pallas_with_shadow_ops():
@@ -137,8 +136,7 @@ def test_density_tapes_ride_pallas_with_shadow_ops():
     fz.run(rho)
     for f, a, kw in circ._tape:
         f(ref, *a, **kw)
-    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(rho.amps), np.asarray(ref.amps))
 
 
 def test_density_channels_fuse_into_pallas_runs():
@@ -176,8 +174,7 @@ def test_density_channels_fuse_into_pallas_runs():
     fz.run(rho)
     for f, a, kw in c._tape:
         f(ref, *a, **kw)
-    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(rho.amps), np.asarray(ref.amps))
     assert abs(qt.calcTotalProb(rho) - 1.0) < TOL
 
 
@@ -215,8 +212,7 @@ def test_three_target_channel_rides_krausn_kernel_op():
     fz.run(rho)
     for f, a, kw in c._tape:
         f(ref, *a, **kw)
-    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(rho.amps), np.asarray(ref.amps))
     assert abs(qt.calcTotalProb(rho) - 1.0) < TOL
 
 
@@ -246,8 +242,7 @@ def test_non_tp_three_target_channel_rides_krausn():
     fz.run(rho)
     for f, a, kw in c._tape:
         f(ref, *a, **kw)
-    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(rho.amps), np.asarray(ref.amps))
 
 
 def test_krausn_signed_terms_kernel_matches_engine():
@@ -284,7 +279,7 @@ def test_krausn_signed_terms_kernel_matches_engine():
         y = K.apply_matrix(amps + 0, km, n=2 * n, targets=rows)
         y = K.apply_matrix(y, km, n=2 * n, targets=cols, conj=True)
         out = _acc_kraus_term(out, sign, y)
-    np.testing.assert_allclose(got, np.asarray(out), atol=TOL, rtol=TOL)
+    assert_amps_close(got, np.asarray(out))
 
 
 def test_density_pallas_with_frame_swaps_matches_oracle():
@@ -312,8 +307,7 @@ def test_density_pallas_with_frame_swaps_matches_oracle():
     fz.run(rho)
     for f, a, kw in circ._tape:
         f(ref, *a, **kw)
-    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(rho.amps), np.asarray(ref.amps))
 
 
 def test_plan_reframes_high_qubit_dense_gates():
@@ -356,15 +350,12 @@ def test_folded_frame_swap_kernel_matches_explicit():
     sw = lambda a: PG.swap_bit_blocks(a + 0, n=n, lo1=tb - k, lo2=tb, k=k)
     run = lambda a, **kw: PG.fused_local_run(jnp.asarray(a) + 0, n=n, ops=ops,
                                              sublanes=8, interpret=True, **kw)
-    np.testing.assert_allclose(
-        np.asarray(run(base, load_swap_k=k)), np.asarray(run(sw(jnp.asarray(base)))),
-        atol=TOL, rtol=TOL)
-    np.testing.assert_allclose(
-        np.asarray(run(base, store_swap_k=k)), np.asarray(sw(run(base))),
-        atol=TOL, rtol=TOL)
-    np.testing.assert_allclose(
-        np.asarray(run(base, load_swap_k=k, store_swap_k=k)),
-        np.asarray(sw(run(sw(jnp.asarray(base))))), atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(run(base, load_swap_k=k)),
+                      np.asarray(run(sw(jnp.asarray(base)))))
+    assert_amps_close(np.asarray(run(base, store_swap_k=k)),
+                      np.asarray(sw(run(base))))
+    assert_amps_close(np.asarray(run(base, load_swap_k=k, store_swap_k=k)),
+                      np.asarray(sw(run(sw(jnp.asarray(base))))))
 
 
 def test_folded_production_path_22q():
@@ -389,8 +380,7 @@ def test_folded_production_path_22q():
 
     amps = fz.as_fn()(ops_init.init_classical(1 << n, real_dtype(), 0))
     ref = circ.as_fn()(ops_init.init_classical(1 << n, real_dtype(), 0))
-    np.testing.assert_allclose(np.asarray(amps), np.asarray(ref),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(amps), np.asarray(ref))
 
 
 def test_lane_fold_on_grid_kernel_path():
@@ -411,8 +401,7 @@ def test_lane_fold_on_grid_kernel_path():
     for q in range(25):
         circ.hadamard(q % 7)
     ref = circ.as_fn()(ops_init.init_debug(1 << n, real_dtype()))
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(got), np.asarray(ref))
 
 
 def test_folded_swap_asymmetric_geometries():
@@ -434,8 +423,7 @@ def test_folded_swap_asymmetric_geometries():
     # load k=1 at hi=12, store k=2 at hi=10 (default tile boundary)
     got = run(base, load_swap_k=1, load_swap_hi=12, store_swap_k=2)
     ref = sw(run(sw(jnp.asarray(base), 1, 12)), 2, tb)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(got), np.asarray(ref))
 
 
 def test_folded_plan_agrees_end_to_end():
@@ -456,9 +444,7 @@ def test_folded_plan_agrees_end_to_end():
             if f.__name__ == "_apply_pallas_run"]
     assert any(lk or sk for lk, sk in anns), "no folded swaps planned"
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
-    np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(fz.as_fn()(mk())), np.asarray(circ.as_fn()(mk())))
 
 
 def test_small_register_falls_back_to_ordinary_fusion():
@@ -468,8 +454,7 @@ def test_small_register_falls_back_to_ordinary_fusion():
     fz = circ.fused(max_qubits=3, pallas=True)
     assert all(f.__name__ != "_apply_pallas_run" for f, _, _ in fz._tape)
     mk = lambda: ops_init.init_debug(1 << 6, real_dtype())
-    np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(fz.as_fn()(mk())), np.asarray(circ.as_fn()(mk())))
 
 
 def test_sharded_register_falls_back_to_engine():
@@ -496,8 +481,7 @@ def test_sharded_register_falls_back_to_engine():
     ref = qt.createQureg(10, qt.createQuESTEnv(jax.devices()[:1]))
     qt.initPlusState(ref)
     circ.run(ref)
-    np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(qureg.amps), np.asarray(ref.amps))
 
 
 def test_sharded_pallas_runs_via_shard_map():
@@ -537,8 +521,7 @@ def test_sharded_pallas_runs_via_shard_map():
     ref = qt.createQureg(n, qt.createQuESTEnv(jax.devices()[:1]))
     qt.initPlusState(ref)
     circ.run(ref)
-    np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(qureg.amps), np.asarray(ref.amps))
 
 
 def test_multi_frame_plan_covers_wide_register():
@@ -569,9 +552,7 @@ def test_multi_frame_plan_covers_wide_register():
     out = Circuit(n)
     out._tape = fusion.as_tape(p)
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
-    np.testing.assert_allclose(np.asarray(out.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(out.as_fn()(mk())), np.asarray(circ.as_fn()(mk())))
 
 
 def test_sharded_multi_frame_collective_transposes():
@@ -609,8 +590,7 @@ def test_sharded_multi_frame_collective_transposes():
     ref = qt.createQureg(n, qt.createQuESTEnv(jax.devices()[:1]))
     qt.initPlusState(ref)
     circ.run(ref)
-    np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(qureg.amps), np.asarray(ref.amps))
 
 
 def test_window_dot_matches_engine():
@@ -628,13 +608,13 @@ def test_window_dot_matches_engine():
         got = PG.window_dot(amps + 0, mp, n=n, lo=lo, hi=lo + 2, interpret=True)
         ref = K.apply_matrix(amps + 0, mp, n=n,
                              targets=(lo, lo + 1, lo + 2))
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=TOL, rtol=TOL)
+        assert_amps_close(np.asarray(got), np.asarray(ref))
         # conjugated form (density shadow)
         got_c = PG.window_dot(amps + 0, mp, n=n, lo=lo, hi=lo + 2,
                               conj=True, interpret=True)
         ref_c = K.apply_matrix(amps + 0, mp, n=n,
                                targets=(lo, lo + 1, lo + 2), conj=True)
-        np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref_c), atol=TOL, rtol=TOL)
+        assert_amps_close(np.asarray(got_c), np.asarray(ref_c))
 
 
 def test_window_alignment_in_pallas_mode():
@@ -655,8 +635,7 @@ def test_window_alignment_in_pallas_mode():
     # semantics preserved end to end
     fz = circ.fused(max_qubits=5, pallas=True)
     mk = lambda: ops_init.init_debug(1 << n, real_dtype())
-    np.testing.assert_allclose(np.asarray(fz.as_fn()(mk())),
-                               np.asarray(circ.as_fn()(mk())), atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(fz.as_fn()(mk())), np.asarray(circ.as_fn()(mk())))
 
 
 def test_sharded_pallas_inside_jitted_replay():
@@ -690,5 +669,4 @@ def test_sharded_pallas_inside_jitted_replay():
     ref = qt.createQureg(n, qt.createQuESTEnv(jax.devices()[:1]))
     qt.initPlusState(ref)
     circ.run(ref)
-    np.testing.assert_allclose(np.asarray(qureg.amps), np.asarray(ref.amps),
-                               atol=TOL, rtol=TOL)
+    assert_amps_close(np.asarray(qureg.amps), np.asarray(ref.amps))
