@@ -121,7 +121,6 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
     """One statevec config: random Clifford+T layers, two-frame fused."""
     import time
 
-    import jax.numpy as jnp
     from quest_tpu.ops import init as ops_init
 
     circ = build_circuit(n, depth)
@@ -162,7 +161,11 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
         fn = fused.compiled(donate=True)
 
     t0 = time.perf_counter()
-    amps = ops_init.init_classical(1 << n, jnp.dtype("float32"), 0)
+    # the configured precision, NOT hardcoded f32: under QUEST_PRECISION=2
+    # the fused plan is built for f64, and mixing f32 amps into it trips an
+    # XLA-internal Mosaic i64 lowering on TPU (round-4 find)
+    from quest_tpu.precision import real_dtype
+    amps = ops_init.init_classical(1 << n, real_dtype(), 0)
     amps = fn(amps)  # compile + warmup
     sync(amps)
     print(f"# {n}q compile+warmup {time.perf_counter() - t0:.1f}s",
