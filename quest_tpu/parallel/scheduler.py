@@ -35,6 +35,32 @@ from .mesh import local_qubit_count
 _STATE = threading.local()
 
 
+def _cycle_swaps(occ, pos, n: int) -> list:
+    """The (a, b) position-swap sequence that restores the identity layout
+    (at most one swap per displaced qubit, cycle restoration). The single
+    source of the swap-chain order -- shared by the A/B cost simulation
+    and the fallback execution path."""
+    occ, pos = list(occ), list(pos)
+    out = []
+    for a in range(n):
+        while occ[a] != a:
+            b = pos[a]
+            out.append((a, b))
+            la, lb = occ[a], occ[b]
+            occ[a], occ[b] = lb, la
+            pos[la], pos[lb] = b, a
+    return out
+
+
+def _swap_price(a: int, b: int, nl: int) -> float:
+    """Chunk-units of one dist_swap, same prices as apply_swap: free when
+    both positions are local, 1 (odd-parity half-exchange) when mixed,
+    2 (full-chunk rank permute) when both are sharded."""
+    if max(a, b) < nl:
+        return 0.0
+    return 2.0 if min(a, b) >= nl else 1.0
+
+
 @dataclass
 class DistributedScheduler:
     """Gate dispatcher bound to a mesh; collects plan stats (number of pair
@@ -63,10 +89,15 @@ class DistributedScheduler:
     num_slices: int = 1
     #: False forces the reference's immediate policy (begin_defer no-ops)
     allow_defer: bool = True
+    #: False reverts reconciliation to the round-3/4 per-cycle swap chain
+    #: (for A/B plan stats; the collective path is the production one)
+    collective_reconcile: bool = True
     stats: dict = field(default_factory=lambda: {
         "pair_exchanges": 0, "relocation_swaps": 0, "rank_permutes": 0,
         "comm_free": 0, "local": 0, "channel_superops": 0,
         "virtual_swaps": 0, "reconcile_swaps": 0,
+        "reconcile_collectives": 0, "reconcile_chunks": 0.0,
+        "reconcile_swap_equiv_chunks": 0.0,
         "ici_chunks": 0.0, "dcn_chunks": 0.0})
 
     def _count_comm(self, n: int, qubit: int, chunks: float) -> None:
@@ -94,9 +125,11 @@ class DistributedScheduler:
         a non-local 1q gate exchanges a full chunk send+recv per rank,
         QuEST_cpu_distributed.c:495-533; a relocation/odd-parity swap moves
         half a chunk each way, :1443-1459; an X-class rank permute
-        re-routes the full chunk; a reconciliation swap costs like a
-        relocation; a virtual swap costs nothing). ``bytes_per_amp`` = 8
-        for planar f32 (re+im), 16 for f64."""
+        re-routes the full chunk; a virtual swap costs nothing;
+        reconciliation contributes its measured ``reconcile_chunks`` --
+        per-swap prices for the swap chain, 2*(1-2^-m) for the grouped
+        all-to-all over m crossing bits plus 2 for a relabel ppermute).
+        ``bytes_per_amp`` = 8 for planar f32 (re+im), 16 for f64."""
         chunk = (1 << n) // self.mesh.size
         amps_moved = chunk * comm_chunks(self.stats)
         return {
@@ -179,23 +212,70 @@ class DistributedScheduler:
         self._pos[la], self._pos[lb] = b, a
 
     def reconcile(self, amps, n: int):
-        """Physically restore the identity layout (logical q at position q)
-        with at most one swap per displaced qubit (cycle restoration).
-        Swaps touching a sharded position are counted as comm traffic;
-        local-local ones are free relabelings."""
+        """Physically restore the identity layout (logical q at position q).
+
+        Production path (round 5): the whole displacement runs as ONE
+        grouped all-to-all (plus a ppermute relabel only when shard bits
+        moved among themselves) -- :func:`..exchange.dist_permute_bits`.
+        The 34q bench plan's reconciliation drops from 7 sequential
+        odd-parity swaps (7 chunk-units; the reference's swapQubitAmps
+        unit, QuEST_cpu_distributed.c:1443-1459) to one collective at
+        <=2 chunk-units. The cheaper policy is chosen per reconciliation
+        (the collective wins on wide displacements: m crossings cost
+        2*(1-2^-m) < m; a shard->shard relabel pays a full 2-unit
+        re-route, so relabel-dominated small displacements keep the swap
+        chain). ``collective_reconcile=False`` forces the swap chain for
+        A/B plan stats. Both paths account their traffic in
+        ``reconcile_chunks`` with the same per-swap prices as
+        :meth:`apply_swap` (1 unit mixed, 2 units both-sharded)."""
         if self._pos is None:
             return amps
         self._ensure_perm(n)
         nl = local_qubit_count(n, self.mesh)
-        for a in range(n):
-            while self._occ[a] != a:
-                b = self._pos[a]  # where logical a currently lives
-                key = "reconcile_swaps" if max(a, b) >= nl else "local"
-                self.stats[key] += 1
-                if max(a, b) >= nl:
-                    self._count_comm(n, max(a, b), 1.0)
+        swaps = _cycle_swaps(self._occ, self._pos, n)
+        if not swaps:
+            return amps
+        # A/B bookkeeping: what the swap chain would pay, recorded under
+        # both policies
+        swap_units = sum(_swap_price(a, b, nl) for a, b in swaps)
+        local_swaps = sum(1 for a, b in swaps if max(a, b) < nl)
+        self.stats["reconcile_swap_equiv_chunks"] += swap_units
+        source = tuple(self._pos)  # new bit q <- old bit pos[q]
+        cstats = X.permute_collective_stats(n, source, self.mesh)
+        if not self.collective_reconcile or \
+                swap_units < cstats["chunk_units"]:
+            for a, b in swaps:
+                price = _swap_price(a, b, nl)
+                if price:
+                    self.stats["reconcile_swaps"] += 1
+                    self.stats["reconcile_chunks"] += price
+                    self._count_comm(n, max(a, b), price)
+                else:
+                    self.stats["local"] += 1
                 amps = X.dist_swap(amps, n=n, qb1=a, qb2=b, mesh=self.mesh)
                 self._swap_positions(a, b)
+            return amps
+        self.stats["reconcile_collectives"] += cstats["collectives"]
+        self.stats["reconcile_chunks"] += cstats["chunk_units"]
+        # the local->local remainder rides the collective's final in-chunk
+        # transpose; keep the op count comparable with the swap chain's
+        self.stats["local"] += local_swaps
+        # link attribution: split the collective's volume evenly over the
+        # participating shard bits (crossing bits for the all-to-all; the
+        # relabeled bits for the ppermute)
+        cross = [q for q in range(nl, n) if source[q] < nl]
+        if cross:
+            share = 2.0 * (1.0 - 0.5 ** len(cross)) / len(cross)
+            for q in cross:
+                self._count_comm(n, q, share)
+        if cstats["relabel_ppermute"]:
+            moved = [q for q in range(nl, n)
+                     if source[q] >= nl and source[q] != q]
+            for q in moved:
+                self._count_comm(n, q, 2.0 / len(moved))
+        amps = X.dist_permute_bits(amps, n=n, source=source, mesh=self.mesh)
+        self._pos = list(range(n))
+        self._occ = list(range(n))
         return amps
 
     def _relocate(self, amps, n, nl, phys_ts, support_phys,
@@ -388,7 +468,8 @@ class DistributedScheduler:
 
 
 @contextmanager
-def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True):
+def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True,
+                  collective_reconcile: bool = True):
     """Route L5 gate application through the explicit shard_map kernels.
     ``num_slices`` > 1 splits the plan's comm stats into ICI vs DCN chunks
     (slice-major device order; parallel.mesh.shard_bit_link)."""
@@ -399,7 +480,8 @@ def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True):
             f"'{AMP_AXIS}' (got axes {tuple(mesh.shape)}); build it with "
             f"createQuESTEnv or Mesh(devices, ('{AMP_AXIS}',))")
     sched = (DistributedScheduler(mesh, num_slices=num_slices,
-                                  allow_defer=defer)
+                                  allow_defer=defer,
+                                  collective_reconcile=collective_reconcile)
              if mesh is not None and mesh.size > 1 else None)
     prev = getattr(_STATE, "sched", None)
     _STATE.sched = sched
@@ -417,14 +499,16 @@ def active() -> DistributedScheduler | None:
 def comm_chunks(stats: dict) -> float:
     """Total comm traffic of a plan in chunk units, the single source of
     the cost-model weights (2 per pair exchange / rank permute, 1 per
-    relocation or reconciliation swap, 0 for virtual swaps) --
-    comm_volume() and every report derive from this."""
+    relocation swap, 0 for virtual swaps, plus ``reconcile_chunks`` --
+    the measured units of whichever reconciliation policy ran, swap chain
+    or collective) -- comm_volume() and every report derive from this."""
     return (2.0 * stats["pair_exchanges"] + 1.0 * stats["relocation_swaps"]
-            + 1.0 * stats["reconcile_swaps"] + 2.0 * stats["rank_permutes"])
+            + 2.0 * stats["rank_permutes"]
+            + stats.get("reconcile_chunks", 0.0))
 
 
 def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
-                 defer: bool = True):
+                 defer: bool = True, collective_reconcile: bool = True):
     """Trace ``circuit`` abstractly under the explicit scheduler and return
     its communication plan stats (no device execution -- jax.eval_shape)."""
     import jax
@@ -434,7 +518,8 @@ def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
 
     nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
     num_amps = 1 << nsv
-    with explicit_mesh(mesh, num_slices=num_slices, defer=defer) as sched:
+    with explicit_mesh(mesh, num_slices=num_slices, defer=defer,
+                       collective_reconcile=collective_reconcile) as sched:
         fn = circuit.as_fn()
         jax.eval_shape(fn, jax.ShapeDtypeStruct((2, num_amps), real_dtype(None)))
     if sched is None:
