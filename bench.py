@@ -39,15 +39,18 @@ import numpy as np
 #: measured 2026-07-31, 1 rep of the depth-8 circuit = ~10.5 min)
 REF_GATES_PER_SEC = {20: 422.99, 24: 23.42, 26: 5.86, 28: 0.54}
 
-#: reference QuEST 14q density channel-ops/sec on this host (same circuit,
-#: tools/ref_bench.c --density 14 5; re-measured 2026-07-31 after the
-#: round-4 addition of the 3-target mixMultiQubitKrausMap to the circuit
-#: (the 6-qubit superoperator pass dominates the reference's step; the
-#: 10-op round-3 circuit anchored at 0.93). 1-core -O3 -DMULTITHREADED=1
-#: build -- kernels timed: densmatr_mixDepolarisingLocal
-#: QuEST_cpu.c:137-185 and the all-arity Kraus superoperator path
-#: QuEST_common.c:581-638.
-REF_DENSITY_CHANNEL_OPS_PER_SEC = {14: 0.20}
+#: reference QuEST 14q density channel-ops/sec on this host
+#: (tools/ref_bench.c --density 14 5; 1-core -O3 -DMULTITHREADED=1 build
+#: -- kernels timed: densmatr_mixDepolarisingLocal QuEST_cpu.c:137-185
+#: and the all-arity Kraus superoperator path QuEST_common.c:581-638).
+#: TWO anchors, one per bench circuit (VERDICT r4 weak #4 / ask #6: the
+#: round-4 circuit added a 3-target mixMultiQubitKrausMap whose 6-qubit
+#: superoperator sweep dominates the reference's step, moving the anchor
+#: 0.93 -> 0.20; both circuits are timed so multiples stay comparable
+#: across rounds):
+#:   "r3" = the 10-op round-3 circuit (anchor 0.93, measured 2026-07-30)
+#:   "r4" = the 11-op circuit incl. krausn (anchor 0.20, measured 2026-07-31)
+REF_DENSITY_CHANNEL_OPS_PER_SEC = {(14, "r3"): 0.93, (14, "r4"): 0.20}
 
 
 def build_circuit(n: int, depth: int):
@@ -59,27 +62,110 @@ def build_circuit(n: int, depth: int):
     return circ
 
 
-def bench_density(n: int, reps: int, sync) -> dict:
-    """BASELINE.json config 4: n-qubit density matrix driven through
-    mixDepolarising + mixKrausMap interleaved with unitaries."""
+#: the fast-window per-pass stream floor at 2^26 amps f32: the anchor that
+#: drift-normalises cross-session headline figures (scales linearly with
+#: state size). Measured with the SAME two-point-slope methodology as
+#: _stream_floor_ms (2026-07-31, barrier-separated multiplies; the
+#: round-4 "2.6 ms" figure was a fixed-cost lottery and is NOT comparable
+#: -- BASELINE.md round-5 correction).
+_FLOOR_ANCHOR_26Q_MS = 1.44
+
+
+def _stream_floor_ms(nsv: int) -> float:
+    """Same-process HBM roofline: one bare XLA elementwise pass over a
+    (2, 2^nsv) state at the configured precision. Emitted with every
+    config so the artifact distinguishes chip-bandwidth drift from kernel
+    overhead (VERDICT r4 weak #1: headline figures were 'a draw from the
+    window lottery' without a same-process floor).
+
+    Methodology (round 5): TWO-POINT SLOPE. A dispatch+sync round on the
+    tunnelled chip carries a large, size-independent fixed cost (measured
+    ~25-100 ms -- the round-4 'per-pass floors' at small states were this
+    artifact divided by the rep count), so the floor is the marginal cost
+    between a short and a long loop-inside-jit program, not any
+    single-call time. The drain scalar is computed INSIDE the program
+    (no eager reshape of the big array through the tunnel)."""
+    import time
+
+    import jax
+
+    from quest_tpu.ops import init as ops_init
+    from quest_tpu.precision import real_dtype
+
+    c = np.asarray(1.0000001, real_dtype())
+    r_small, r_big = (50, 550) if nsv <= 22 else (10, 110)
+
+    def make(r):
+        @jax.jit
+        def looped(x):
+            for _ in range(r):
+                # the barrier keeps each multiply a separate HBM pass --
+                # XLA would otherwise fuse the whole chain into ONE pass
+                # (which is what the round-4 floor probes unknowingly
+                # measured)
+                x = jax.lax.optimization_barrier(x) * c
+            return x, x[0, 0] + x[1, 1]
+        return looped
+
+    f_s, f_b = make(r_small), make(r_big)
+    amps = ops_init.init_classical(1 << nsv, real_dtype(), 0)
+    for f in (f_s, f_b):  # compile + warmup
+        amps, s = f(amps)
+        float(jax.device_get(s))
+
+    def timed(f):
+        nonlocal amps
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            amps2, s = f(amps)
+            amps2, s2 = f(amps2)
+            float(jax.device_get(s2))
+            amps = amps2
+            best = min(best, (time.perf_counter() - t0) / 2)
+        return best
+
+    tb, ts = timed(f_b), timed(f_s)
+    del amps
+    return max((tb - ts) / (r_big - r_small) * 1e3, 1e-4)
+
+
+def _roofline(nsv: int, circuit_ms: float, passes: int) -> dict:
+    """Per-config roofline block: the same-window stream floor, the
+    per-pass cost, their ratio, the implied effective bandwidth, and the
+    drift-normalisation factor (measured_floor / floor_anchor -- multiply
+    the headline by it to restate it at the fast-window anchor
+    bandwidth)."""
+    from quest_tpu.precision import real_dtype
+
+    floor_ms = _stream_floor_ms(nsv)
+    bytes_per_pass = 2 * (1 << nsv) * 2 * np.dtype(real_dtype()).itemsize
+    per_pass = circuit_ms / max(passes, 1)
+    anchor = _FLOOR_ANCHOR_26Q_MS * (1 << nsv) / (1 << 26) * \
+        np.dtype(real_dtype()).itemsize / 4
+    return {
+        "stream_floor_ms": round(floor_ms, 3),
+        "per_pass_ms": round(per_pass, 3),
+        "passes": passes,
+        "per_pass_vs_floor": round(per_pass / floor_ms, 2),
+        "eff_bandwidth_gbs": round(bytes_per_pass / floor_ms / 1e6, 1),
+        "drift_norm_factor": round(floor_ms / anchor, 4),
+        "_floor_over_anchor": floor_ms / anchor,  # unrounded, for callers
+    }
+
+
+def _density_circuit(n: int, with_krausn: bool):
+    """The bench channel circuit. ``with_krausn=False`` is the 10-op
+    round-3 circuit (anchor 0.93); True adds the 3-target Kraus map
+    (round-4, rides the one-pass 'krausn' kernel op; reference anchor
+    0.20 because its 6-qubit superoperator sweep dominates,
+    QuEST_common.c:581-638)."""
     import numpy as np
 
-    import quest_tpu as qt
     from quest_tpu.circuits import Circuit
-
-    env = qt.createQuESTEnv()
-    rho = qt.createDensityQureg(n, env)
-    qt.initPlusState(rho)
 
     k = 1 / np.sqrt(2)
     kraus = [np.array([[k, 0], [0, k]]), np.array([[0, k], [k, 0]])]
-    # representative channel step: unitaries + both decoherence families +
-    # a 3-target Kraus map (rides the round-4 'krausn' one-pass kernel op).
-    # Kept lean: a 14q density register is 2^28 amps and each Kraus channel
-    # lowers to several full passes, so op count drives remote-compile time.
-    xxx = np.kron(np.kron([[0, 1], [1, 0]], [[0, 1], [1, 0]]),
-                  [[0, 1], [1, 0]])
-    kraus3 = [0.8 * xxx, 0.6j * np.eye(8)]  # CPTP: 0.64 I + 0.36 I
     circ = Circuit(n, is_density_matrix=True)
     for q in range(4):
         circ.hadamard(q)
@@ -89,31 +175,77 @@ def bench_density(n: int, reps: int, sync) -> dict:
     circ.mixDepolarising(n - 1, 0.05)
     circ.mixKrausMap(1, kraus)
     circ.mixTwoQubitDephasing(0, 1, 0.1)
-    circ.mixMultiQubitKrausMap([2, 3, 4], kraus3)
-    num_ops = len(circ)
-    # pallas=True: the unitary prefix rides fused kernel runs with explicit
-    # conj-shadow ops (round-3 density fast path); channels stay barriers
-    # on their own fused-Kraus passes
-    fn = circ.fused(max_qubits=4, pallas=True).compiled_blocks(
-        max_gates=4, donate=True)
+    if with_krausn:
+        xxx = np.kron(np.kron([[0, 1], [1, 0]], [[0, 1], [1, 0]]),
+                      [[0, 1], [1, 0]])
+        kraus3 = [0.8 * xxx, 0.6j * np.eye(8)]  # CPTP: 0.64 I + 0.36 I
+        circ.mixMultiQubitKrausMap([2, 3, 4], kraus3)
+    return circ
 
+
+def bench_density(n: int, reps: int, sync) -> dict:
+    """BASELINE.json config 4: n-qubit density matrix driven through
+    mixDepolarising + mixKrausMap interleaved with unitaries.
+
+    BOTH bench circuits are timed (VERDICT r4 ask #6): the 11-op round-4
+    circuit is the headline; the 10-op round-3 circuit keeps the
+    round-over-round anchor stable."""
     import time
-    amps = rho.amps
-    amps = fn(amps)
-    sync(amps)
-    t0 = time.perf_counter()
-    for _ in range(reps):
+
+    import quest_tpu as qt
+
+    env = qt.createQuESTEnv()
+
+    def run_one(tag: str, with_krausn: bool):
+        rho = qt.createDensityQureg(n, env)
+        qt.initPlusState(rho)
+        circ = _density_circuit(n, with_krausn)
+        num_ops = len(circ)
+        # pallas=True: the unitary prefix rides fused kernel runs with
+        # explicit conj-shadow ops; channels stay barriers on their own
+        # fused-Kraus passes
+        fn = circ.fused(max_qubits=4, pallas=True).compiled_blocks(
+            max_gates=4, donate=True)
+        amps = rho.amps
         amps = fn(amps)
-    sync(amps)
-    dt = time.perf_counter() - t0
-    val = num_ops * reps / dt
-    ref = REF_DENSITY_CHANNEL_OPS_PER_SEC.get(n)
+        sync(amps)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            amps = fn(amps)
+        sync(amps)
+        dt1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(2 * reps):
+            amps = fn(amps)
+        sync(amps)
+        dt2 = time.perf_counter() - t0
+        del amps
+        val = num_ops * 3 * reps / (dt1 + dt2)
+        ref = REF_DENSITY_CHANNEL_OPS_PER_SEC.get((n, tag))
+        return val, ref, dt2 - dt1
+
+    val_r3, ref_r3, _ = run_one("r3", with_krausn=False)
+    val_r4, ref_r4, dt4 = run_one("r4", with_krausn=True)
+    roof = _roofline(2 * n, dt4 / reps * 1e3, 1)
+    roof.pop("_floor_over_anchor")
+    roof.pop("per_pass_ms"), roof.pop("passes"), roof.pop("per_pass_vs_floor")
     return {
         "metric": f"channel-ops/sec, {n}-qubit density matrix "
                   f"(mixDepolarising+mixKrausMap)",
-        "value": round(val, 2),
+        "value": round(val_r4, 2),
         "unit": "ops/sec",
-        "vs_baseline": round(val / ref, 3) if ref else None,
+        "vs_baseline": round(val_r4 / ref_r4, 3) if ref_r4 else None,
+        "detail": {
+            "r4_circuit_11op": {"value": round(val_r4, 2),
+                                "anchor": ref_r4,
+                                "vs_baseline": round(val_r4 / ref_r4, 3)
+                                if ref_r4 else None},
+            "r3_circuit_10op": {"value": round(val_r3, 2),
+                                "anchor": ref_r3,
+                                "vs_baseline": round(val_r3 / ref_r3, 3)
+                                if ref_r3 else None},
+            **roof,
+        },
     }
 
 
@@ -129,9 +261,11 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
     # runs measure tunnel jitter
     if n < 22:
         reps *= 4
-    # chain 2 circuit applications per program at 22-25q: one ~6.5 ms
-    # tunnel dispatch per ~20-40 ms circuit is a measurable tax there
-    inner = 4 if n < 22 else (2 if n < 26 else 1)
+    # chain circuit applications per program: one ~6.5 ms tunnel dispatch
+    # per circuit is a ~35% tax at 20q even with 4 chained (round-4); 16
+    # at <22q / 4 at 22-25q / 2 at 26q+ amortise it below ~5% everywhere
+    # (VERDICT r4 asks #4/#5)
+    inner = 16 if n < 22 else (4 if n < 26 else 2)
     # two-frame pallas from 20q up: with frame swaps folded into the run
     # DMA (round 3) the fused kernel wins well below the HBM-resident
     # sizes (20q measured 96k gates/s pallas vs 31k XLA same-session);
@@ -141,11 +275,11 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
           file=sys.stderr)
     if len(fused) > 48:
         fn = fused.compiled_blocks(max_gates=24, donate=True)
+        inner = 1
     elif inner > 1:
-        # dispatch-bound circuits (sub-3ms outright below 22q; a ~15%
-        # tunnel-dispatch tax at 22-25q): chain INNER applications inside
-        # one program (the loop-inside-jit methodology of
-        # tools/microbench.py) so the timed region measures device work
+        # chain INNER applications inside one program (the loop-inside-jit
+        # methodology of tools/microbench.py) so the timed region measures
+        # device work, not the tunnel dispatch
         import jax
 
         base = fused.as_fn()
@@ -171,20 +305,47 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
     print(f"# {n}q compile+warmup {time.perf_counter() - t0:.1f}s",
           file=sys.stderr)
 
+    # two timed regions (reps and 2*reps programs): the tunnel carries a
+    # large fixed dispatch+sync cost per region (measured ~25-100 ms,
+    # round 5), so the SLOPE between them is the device rate; the
+    # headline uses the all-programs total (same methodology as earlier
+    # rounds, more reps), with the fixed cost reported alongside
     t0 = time.perf_counter()
     for _ in range(reps):
         amps = fn(amps)
     sync(amps)
-    dt = time.perf_counter() - t0
+    dt1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(2 * reps):
+        amps = fn(amps)
+    sync(amps)
+    dt2 = time.perf_counter() - t0
     del amps
 
-    gates_per_sec = num_gates * reps / dt
+    gates_per_sec = num_gates * 3 * reps / (dt1 + dt2)
+    device_rate = num_gates * reps / max(dt2 - dt1, 1e-9)
+    fixed_ms = max(2 * dt1 - dt2, 0.0) * 1e3
     ref = REF_GATES_PER_SEC.get(n)
+    roof = _roofline(n, (dt2 - dt1) / reps * 1e3,
+                     len(fused) * inner)
+    norm = gates_per_sec * roof.pop("_floor_over_anchor")
     return {
         "metric": f"gate-ops/sec, {n}-qubit state-vector random Clifford+T",
         "value": round(gates_per_sec, 2),
         "unit": "gates/sec",
         "vs_baseline": round(gates_per_sec / ref, 3) if ref else None,
+        "detail": {
+            "chained_circuits": inner, "blocks_per_circuit": len(fused),
+            # marginal (fixed-dispatch-free) device throughput + the
+            # measured per-region fixed cost it excludes
+            "device_gates_per_sec": round(device_rate, 1),
+            "dispatch_fixed_ms": round(fixed_ms, 1),
+            **roof,
+            # the headline scaled to the fast-window bandwidth anchor:
+            # cross-session-comparable (the chip's effective bandwidth
+            # swings ~5x between windows, BASELINE.md drift warning)
+            "drift_normalized_gates_per_sec": round(norm, 1),
+        },
     }
 
 
@@ -253,6 +414,73 @@ def _dist_comm_plan(circ) -> dict:
     }
 
 
+def plan_17q_density_distributed() -> dict:
+    """The SECOND BASELINE.json north-star target (VERDICT r4 missing #1):
+    a 17-qubit density-matrix depolarising-channel workload sharded over a
+    v5p-16. 34 flattened qubits cannot fit one chip; report the trace-time
+    sharded Pallas plan -- per-shard kernel runs with the channels riding
+    kraus ops, collective vs shard-local frame transposes, and the
+    deferred-scheduler comm stats -- mirroring the 34q state-vector
+    artifact. Reference counterpart: the distributed density-channel
+    protocol, QuEST_cpu_distributed.c:724-749 (single-qubit) and :778-868
+    (two-qubit depolarising, 3-exchange); the dryrun executes a scaled
+    replica (>=8q density on the 8-device CPU mesh)."""
+    from quest_tpu import fusion
+
+    n, ndev = 17, 16
+    circ = _density_circuit(n, with_krausn=True)
+    # make the sharded-column regime explicit: a channel whose column
+    # coordinate (q + n) lives above the 30-qubit shard boundary
+    circ.mixDepolarising(n - 2, 0.03)
+    fz = circ.fused(max_qubits=4, pallas=True, shard_devices=ndev)
+    runs = [a for f, a, _ in fz._tape
+            if f.__name__ == "_apply_pallas_run"]
+    kraus_ops = [op for a in runs for op in a[0]
+                 if op[0].startswith("kraus")]
+    # transposes = folded load/store swaps counted separately, plus any
+    # standalone FrameSwap tape entries
+    n_coll = (sum(int(bool(a[2])) + int(bool(a[3])) for a in runs)
+              + sum(1 for f, _, _ in fz._tape
+                    if f.__name__ == "_apply_frame_swap"))
+    detail = {
+        "channel_ops": sum(
+            1 for f, _, _ in _density_circuit(n, True)._tape
+            if f.__name__.startswith("mix")) + 1,
+        "pallas_runs": len(runs),
+        "kraus_kernel_ops": len(kraus_ops),
+        "kraus_arities": sorted({op[0] for op in kraus_ops}),
+        "frame_transposes": n_coll,
+        "flattened_qubits": 2 * n,
+        "examples": "__graft_entry__.dryrun_multichip density leg",
+    }
+    try:
+        from jax.sharding import AbstractMesh
+
+        from quest_tpu.environment import AMP_AXIS
+        from quest_tpu.parallel.scheduler import comm_chunks, plan_circuit
+
+        mesh = AbstractMesh((ndev,), (AMP_AXIS,))
+        deferred = plan_circuit(circ, mesh)
+        immediate = plan_circuit(circ, mesh, defer=False)
+        detail["comm_plan_16dev"] = {
+            "deferred_chunks": comm_chunks(deferred),
+            "reference_policy_chunks": comm_chunks(immediate),
+            "reduction_pct": round(100 * (1 - comm_chunks(deferred) /
+                                          max(comm_chunks(immediate), 1)),
+                                   1),
+        }
+    except Exception as e:  # plan stats must not sink the artifact
+        detail["comm_plan_16dev"] = f"unavailable: {e}"
+    return {
+        "metric": "17q density-matrix channel plan: per-shard Pallas runs "
+                  "with kraus ops for v5p-16 execution",
+        "value": len(kraus_ops),
+        "unit": "kraus kernel ops",
+        "vs_baseline": None,
+        "detail": detail,
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--qubits", type=int, default=26)
@@ -297,8 +525,9 @@ def main() -> None:
     configs = []
     for n in (20, 24, 26):
         configs.append(bench_statevec(n, args.depth, args.reps, sync))
-    configs.append(_budgeted_density(args.reps, budget_s=420))
+    configs.append(_budgeted_density(args.reps, budget_s=900))
     configs.append(plan_34q_distributed())
+    configs.append(plan_17q_density_distributed())
     # headline = the 26q statevec config, selected by metric string so list
     # reordering can never silently change what is reported
     headline = dict(next(c for c in configs

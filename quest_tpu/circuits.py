@@ -336,7 +336,16 @@ class Circuit:
             # below 2^LANE_BITS amplitudes there is no lane tile to build;
             # the ordinary fusion path handles such registers
             if n_eff > LANE_BITS:
-                tile_bits = local_qubits(n_eff)
+                dt_plan = np.dtype(dtype) if dtype else real_dtype()
+                if dt_plan == np.dtype("float64") and \
+                        jax.default_backend() == "tpu":
+                    # f64 on TPU runs the double-float kernel, whose
+                    # tuned tile is smaller (ops/pallas_df.DF_SUBLANES);
+                    # CPU keeps the native-f64 interpreter geometry
+                    from .ops.pallas_df import DF_SUBLANES
+                    tile_bits = local_qubits(n_eff, DF_SUBLANES)
+                else:
+                    tile_bits = local_qubits(n_eff)
         dt = np.dtype(dtype) if dtype else real_dtype()
         if tile_bits is not None and shard_boundary is not None:
             # sharded: try plain and boundary-aligned frame tilings, keep

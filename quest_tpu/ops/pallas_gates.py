@@ -162,8 +162,10 @@ def _partner(arr, q: int):
     (Mosaic lowers cross-sublane rolls to very slow shuffle sequences;
     round-3 microbench, the single biggest kernel cost discovered)."""
     if q < LANE_BITS:
-        m = 1 << q
-        size = arr.shape[1]
+        # np.int32 shifts: under jax x64 a python int would trace as i64,
+        # which Mosaic's tpu.dynamic_rotate rejects (round-5 df path find)
+        m = np.int32(1 << q)
+        size = np.int32(arr.shape[1])
         up = pltpu.roll(arr, size - m, 1)  # up[i] = arr[i + m] (shift >= 0)
         dn = pltpu.roll(arr, m, 1)         # dn[i] = arr[i - m]
         bit = _bit_mask(q, arr.shape)
@@ -181,14 +183,17 @@ def _ctrl_scalar_and_mask(controls, states, tile_bits, shape, gbit):
     states = states if states else (1,) * len(controls)
     mask = None
     scalar = None
+    # np.int32 literals: under jax x64 (PRECISION=2 df kernels) python
+    # ints would make these i64 vectors, which Mosaic cannot lower
+    one, zero = np.int32(1), np.int32(0)
     for c, st in zip(controls, states):
         if c >= tile_bits:
             b = gbit(c)
-            ok = jnp.where(b == st, 1, 0)
+            ok = jnp.where(b == st, one, zero)
             scalar = ok if scalar is None else scalar * ok
         else:
             b = _bit_mask(c, shape)
-            ok = jnp.where(b == st, 1, 0)
+            ok = jnp.where(b == st, one, zero)
             mask = ok if mask is None else mask * ok
     return scalar, mask
 
@@ -653,7 +658,7 @@ def _ops_body(ops, xr, xi, *, tile_bits, dtype, gbit, get_w):
 
 
 def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
-                 load_swap=None, store_swap=None):
+                 load_swap=None, store_swap=None, df=False):
     """BlockSpec-pipelined grid kernel over (x_ref, hi_ref, *w_refs,
     o_ref); ops of kind 'lane_u'/'window' carry an index into w_refs
     (their block matrices arrive as operands -- Pallas kernels may not
@@ -675,44 +680,53 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
     the reference hot loop QuEST_cpu.c:1682-1739; see fusion._FramePlanner).
     """
 
+    P = 4 if df else 2
+
     def kernel(x_ref, hi_ref, *refs):
         w_refs = refs[:-1]
         o_ref = refs[-1]
         if load_swap is not None:
-            # (2, 1, dk, 1, 1, s_low, 128) block: axis 2 is the (old)
+            # (P, 1, dk, 1, 1, s_low, 128) block: axis 2 is the (old)
             # grid-bit block, already sitting where the new frame's high
             # sublane bits belong -- collapsing (dk, s_low) into the sublane
             # axis IS the bit-block swap, and is layout-free when s_low
             # fills >= 1 sublane tile (the callers guarantee s_low >= 8)
             dk, s_low = load_swap
-            xr = x_ref[0, 0, :, 0, 0].reshape(dk * s_low, _LANES)
-            xi = x_ref[1, 0, :, 0, 0].reshape(dk * s_low, _LANES)
+            planes = [x_ref[i, 0, :, 0, 0].reshape(dk * s_low, _LANES)
+                      for i in range(P)]
         else:
-            xr = x_ref[0]
-            xi = x_ref[1]
+            planes = [x_ref[i] for i in range(P)]
 
         def gbit(q):
             if local_n is not None and q >= local_n:
                 return (hi_ref[0] >> (q - local_n)) & 1
             return _grid_bit(q, tile_bits)
 
-        xr, xi = _ops_body(ops, xr, xi, tile_bits=tile_bits,
-                           dtype=dtype, gbit=gbit,
-                           get_w=lambda i: w_refs[i][:])
+        if df:
+            from .pallas_df import _ops_body_df
+            (rh, rl), (ih, il) = _ops_body_df(
+                ops, (planes[0], planes[2]), (planes[1], planes[3]),
+                tile_bits=tile_bits, gbit=gbit)
+            planes = [rh, ih, rl, il]
+        else:
+            xr, xi = _ops_body(ops, planes[0], planes[1],
+                               tile_bits=tile_bits, dtype=dtype, gbit=gbit,
+                               get_w=lambda i: w_refs[i][:])
+            planes = [xr, xi]
 
         if store_swap is not None:
             dk, s_low = store_swap
-            o_ref[0, 0, :, 0, 0] = xr.reshape(dk, s_low, _LANES)
-            o_ref[1, 0, :, 0, 0] = xi.reshape(dk, s_low, _LANES)
+            for i in range(P):
+                o_ref[i, 0, :, 0, 0] = planes[i].reshape(dk, s_low, _LANES)
         else:
-            o_ref[0] = xr
-            o_ref[1] = xi
+            for i in range(P):
+                o_ref[i] = planes[i]
 
     return kernel
 
 
 def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
-                     nchunks: int, load_swap, store_swap):
+                     nchunks: int, load_swap, store_swap, df=False):
     """Manual double-buffered-DMA kernel: ONE pallas program owns the whole
     pass, looping over the 2^grid chunks with explicit async copies --
     next chunk's load and previous chunk's store overlap the current
@@ -726,6 +740,8 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
     bit-block-swap view (_swap_view) and each chunk load/store is one
     strided descriptor gathering/scattering the dk sub-blocks."""
 
+    P = 4 if df else 2
+
     def kernel(x_hbm, *refs):
         w_refs = refs[:-1]
         o_hbm = refs[-1]
@@ -733,13 +749,30 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
         def body(ins, outs, rsem, wsem):
             def chunk_coords(geo, c):
                 # decompose the chunk index against THIS DMA's swap
-                # geometry (load and store may use different k / hi)
+                # geometry (load and store may use different k / hi);
+                # static (python int) chunk indices compute on the host,
+                # traced ones via lax with np.int32 divisors (Mosaic's
+                # memref_slice rejects i64 operands)
                 dk, _, gm_sz = geo
-                gm = jax.lax.rem(c, gm_sz)
-                rest = jax.lax.div(c, gm_sz)
-                return (jax.lax.div(rest, dk), gm, jax.lax.rem(rest, dk))
+                if isinstance(c, (int, np.integer)):
+                    gm = np.int32(c % gm_sz)
+                    rest = c // gm_sz
+                    return (np.int32(rest // dk), gm, np.int32(rest % dk))
+                # jnp operators: weak-typed python divisors adapt to the
+                # (traced) counter dtype (lax.rem would canonicalise the
+                # literal to i64 under jax x64 and mismatch the i32 c)
+                gm = c % gm_sz
+                rest = c // gm_sz
+                return (rest // dk, gm, rest % dk)
+
+            def _i32(v):
+                # static python indices canonicalise to i64 under jax
+                # x64, which Mosaic's memref_slice rejects
+                return np.int32(v) if isinstance(v, (int, np.integer)) \
+                    else v
 
             def load_dma(slot, c):
+                slot, c = _i32(slot), _i32(c)
                 if load_swap is None:
                     return pltpu.make_async_copy(
                         x_hbm.at[:, c], ins.at[slot], rsem.at[slot])
@@ -749,6 +782,7 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
                     rsem.at[slot])
 
             def store_dma(slot, c):
+                slot, c = _i32(slot), _i32(c)
                 if store_swap is None:
                     return pltpu.make_async_copy(
                         outs.at[slot], o_hbm.at[:, c], wsem.at[slot])
@@ -764,37 +798,57 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
                     return (c >> (q - tile_bits)) & 1
                 return gbit
 
+            def load_planes(slot):
+                if load_swap is not None:
+                    dk, s_low, _ = load_swap
+                    return [ins[slot, i].reshape(dk * s_low, _LANES)
+                            for i in range(P)]
+                return [ins[slot, i] for i in range(P)]
+
+            def compute(planes, gbit):
+                if df:
+                    from .pallas_df import _ops_body_df
+                    (rh, rl), (ih, il) = _ops_body_df(
+                        ops, (planes[0], planes[2]),
+                        (planes[1], planes[3]),
+                        tile_bits=tile_bits, gbit=gbit)
+                    return [rh, ih, rl, il]
+                xr, xi = _ops_body(ops, planes[0], planes[1],
+                                   tile_bits=tile_bits,
+                                   dtype=dtype, gbit=gbit,
+                                   get_w=lambda i: w_refs[i][:])
+                return [xr, xi]
+
+            def store_planes(slot, planes):
+                if store_swap is not None:
+                    dk, s_low, _ = store_swap
+                    for i in range(P):
+                        outs[slot, i] = planes[i].reshape(dk, s_low, _LANES)
+                else:
+                    for i in range(P):
+                        outs[slot, i] = planes[i]
+
             def loop(c, carry):
-                slot = jax.lax.rem(c, 2)
-                nxt = jax.lax.rem(c + 1, 2)
+                # under jax x64 (df kernels) the fori counter
+                # canonicalises to i64, which Mosaic rejects in every
+                # DMA index; lax.convert_element_type (NOT .astype,
+                # which recurses in the pallas tracer) pins it to i32
+                c = jax.lax.convert_element_type(c, jnp.int32)
+                slot = c % 2
+                nxt = (c + 1) % 2
 
                 @pl.when(c + 1 < nchunks)
                 def _():
                     load_dma(nxt, c + 1).start()
 
                 load_dma(slot, c).wait()
-                if load_swap is not None:
-                    dk, s_low, _ = load_swap
-                    xr = ins[slot, 0].reshape(dk * s_low, _LANES)
-                    xi = ins[slot, 1].reshape(dk * s_low, _LANES)
-                else:
-                    xr = ins[slot, 0]
-                    xi = ins[slot, 1]
-                xr, xi = _ops_body(ops, xr, xi, tile_bits=tile_bits,
-                                   dtype=dtype, gbit=gbit_for(c),
-                                   get_w=lambda i: w_refs[i][:])
+                planes = compute(load_planes(slot), gbit_for(c))
 
                 @pl.when(c >= 2)
                 def _():
                     store_dma(slot, c - 2).wait()
 
-                if store_swap is not None:
-                    dk, s_low, _ = store_swap
-                    outs[slot, 0] = xr.reshape(dk, s_low, _LANES)
-                    outs[slot, 1] = xi.reshape(dk, s_low, _LANES)
-                else:
-                    outs[slot, 0] = xr
-                    outs[slot, 1] = xi
+                store_planes(slot, planes)
                 store_dma(slot, c).start()
                 return carry
 
@@ -804,14 +858,14 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
 
         if load_swap is not None:
             dk, s_low, _ = load_swap
-            in_shape = (2, dk, s_low, _LANES)
+            in_shape = (P, dk, s_low, _LANES)
         else:
-            in_shape = (2, s, _LANES)
+            in_shape = (P, s, _LANES)
         if store_swap is not None:
             dk, s_low, _ = store_swap
-            out_shape = (2, dk, s_low, _LANES)
+            out_shape = (P, dk, s_low, _LANES)
         else:
-            out_shape = (2, s, _LANES)
+            out_shape = (P, s, _LANES)
         pl.run_scoped(
             body,
             ins=pltpu.VMEM((2,) + in_shape, dtype),
@@ -858,6 +912,12 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
             f"registers below {LANE_BITS + 1} qubits take the ordinary path")
     if (load_swap_k or store_swap_k) and shard_index is not None:
         raise ValueError("folded frame swaps cannot run per-shard")
+    # double-float layout (4 planes = re/im x hi/lo, ops/pallas_df): pure
+    # VPU arithmetic, so zone folding (MXU dots) is skipped
+    df = amps.shape[0] == 4
+    if df and shard_index is not None:
+        raise ValueError("the double-float path does not run per-shard; "
+                         "sharded f64 registers use the engine path")
 
     lq = local_qubits(n, sublanes)
     for o in ops:
@@ -873,7 +933,7 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
         shard_index = jnp.asarray(shard_index, jnp.int32).reshape(1)
         local_n = n
     return _fused_local_run(amps, shard_index, n=n,
-                            ops=_fold_zone_ops(ops, lq),
+                            ops=tuple(ops) if df else _fold_zone_ops(ops, lq),
                             sublanes=sublanes, interpret=bool(interpret),
                             local_n=local_n, load_swap_k=int(load_swap_k),
                             store_swap_k=int(store_swap_k),
@@ -882,20 +942,21 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
 
 
 def _swap_view(x, rows: int, s: int, lo2_rel: int, k: int):
-    """(2, rows, 128) -> the 7-D bit-block-swap view
-    (2, high, dg, gmid, ds, s_low, 128): ``dg`` is the k-bit grid block at
+    """(P, rows, 128) -> the 7-D bit-block-swap view
+    (P, high, dg, gmid, ds, s_low, 128): ``dg`` is the k-bit grid block at
     row bits [lo2_rel, lo2_rel+k), ``ds`` the top-k sublane block at
     [s_bits-k, s_bits), ``gmid`` the grid bits between them. Exchanging dg
     and ds relabels amplitudes exactly like swap_bit_blocks(tb-k, lo2, k)
-    -- lo2 may be ANY grid-bit offset, not just the tile boundary."""
+    -- lo2 may be ANY grid-bit offset, not just the tile boundary. P = 2
+    planar planes (re, im), or 4 in the double-float layout."""
     s_bits = s.bit_length() - 1
     dk = 1 << k
     gmid = 1 << (lo2_rel - s_bits)
     high = rows // (dk * gmid * (s >> k) * dk)
-    return x.reshape(2, high, dk, gmid, dk, s >> k, _LANES)
+    return x.reshape(x.shape[0], high, dk, gmid, dk, s >> k, _LANES)
 
 
-def _swap_spec(s: int, lo2_rel: int, k: int):
+def _swap_spec(s: int, lo2_rel: int, k: int, planes: int = 2):
     """BlockSpec gathering/scattering one swap-permuted tile per program:
     for new grid index i, all dk positions of the old grid block, at the
     old-sublane-block position encoded in i's [lo2_rel - s_bits) bits --
@@ -910,7 +971,7 @@ def _swap_spec(s: int, lo2_rel: int, k: int):
         rest = i // gm_sz
         return (0, rest // dk, 0, gm, rest % dk, 0, 0)
 
-    return pl.BlockSpec((2, 1, dk, 1, 1, s >> k, _LANES), imap,
+    return pl.BlockSpec((planes, 1, dk, 1, 1, s >> k, _LANES), imap,
                         memory_space=pltpu.VMEM)
 
 
@@ -924,6 +985,8 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
                      load_swap_hi: int | None = None,
                      store_swap_hi: int | None = None):
     num = amps.shape[-1]
+    P = amps.shape[0]          # 2 planar planes, or 4 in df layout
+    df = P == 4
     rows = max(num >> LANE_BITS, 1)
     s = min(sublanes, rows)
     s_bits = int(math.log2(s)) if s > 1 else 0
@@ -965,7 +1028,7 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
                           np.asarray(o[3].arr if hasattr(o[3], "arr") else o[3])))
         else:
             ops_r.append(o)
-    x = amps.reshape(2, rows, _LANES)
+    x = amps.reshape(P, rows, _LANES)
     lo2_load = (load_swap_hi if load_swap_hi is not None else tile_bits)
     lo2_store = (store_swap_hi if store_swap_hi is not None else tile_bits)
 
@@ -983,14 +1046,15 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
         lsw = swap_geo(load_swap_k, lo2_load)
         ssw = swap_geo(store_swap_k, lo2_store)
         x_in = (_swap_view(x, rows, s, lo2_load - LANE_BITS, load_swap_k)
-                if load_swap_k else x.reshape(2, grid, s, _LANES))
+                if load_swap_k else x.reshape(P, grid, s, _LANES))
         if store_swap_k:
             oshape = _swap_view(x, rows, s, lo2_store - LANE_BITS,
                                 store_swap_k).shape
         else:
-            oshape = (2, grid, s, _LANES)
+            oshape = (P, grid, s, _LANES)
         kernel = _make_dma_kernel(tuple(ops_r), s, tile_bits,
-                                  np.dtype(amps.dtype), grid, lsw, ssw)
+                                  np.dtype(amps.dtype), grid, lsw, ssw,
+                                  df=df)
         out = pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct(oshape, x.dtype),
@@ -1001,19 +1065,38 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=interpret,
         )(x_in, *ws)
-        return out.reshape(2, -1)
+        return out.reshape(P, -1)
 
     kernel = _make_kernel(
         tuple(ops_r), s_bits, tile_bits, np.dtype(amps.dtype),
-        local_n=local_n,
+        local_n=local_n, df=df,
         load_swap=(1 << load_swap_k, s >> load_swap_k) if load_swap_k else None,
         store_swap=(1 << store_swap_k, s >> store_swap_k) if store_swap_k else None)
 
-    plain = pl.BlockSpec((2, s, _LANES), lambda i: (0, i, 0),
+    if df and grid == 1:
+        # single-tile df call: Mosaic fails to legalize the 4-plane block
+        # under a grid (func.return legalization, round-5 find); gridless
+        # whole-array VMEM refs compile fine (frame swaps never reach
+        # here: a one-tile register has no grid bits to exchange)
+        assert not (load_swap_k or store_swap_k)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.SMEM)] +
+                     [pl.BlockSpec(memory_space=pltpu.VMEM) for _ in ws],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=interpret,
+        )(x, shard_index, *ws)
+        return out.reshape(P, -1)
+
+    plain = pl.BlockSpec((P, s, _LANES), lambda i: (0, i, 0),
                          memory_space=pltpu.VMEM)
     if load_swap_k:
         x_in = _swap_view(x, rows, s, lo2_load - LANE_BITS, load_swap_k)
-        in_spec0 = _swap_spec(s, lo2_load - LANE_BITS, load_swap_k)
+        in_spec0 = _swap_spec(s, lo2_load - LANE_BITS, load_swap_k, planes=P)
     else:
         x_in = x
         in_spec0 = plain
@@ -1021,7 +1104,8 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
         out_shape = jax.ShapeDtypeStruct(
             _swap_view(x, rows, s, lo2_store - LANE_BITS,
                        store_swap_k).shape, x.dtype)
-        out_spec = _swap_spec(s, lo2_store - LANE_BITS, store_swap_k)
+        out_spec = _swap_spec(s, lo2_store - LANE_BITS, store_swap_k,
+                              planes=P)
     else:
         out_shape = jax.ShapeDtypeStruct(x.shape, x.dtype)
         out_spec = plain
@@ -1040,7 +1124,7 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(x_in, shard_index, *ws)
-    return out.reshape(2, -1)
+    return out.reshape(P, -1)
 
 
 #: largest contiguous-window span window_dot accepts (2D sublane rows = 128)

@@ -1,0 +1,101 @@
+"""On-chip verification of the double-float (PRECISION=2) kernel path.
+
+XLA CPU cannot preserve error-free-transform semantics (its fusion pass
+duplicates producer expressions into consumer kernels and LLVM contracts
+each copy differently, round-5 find), so CI pins the df path's SEMANTICS at
+CPU-achievable tolerance only (tests/test_pallas.py df tests). This tool
+asserts the PRECISION claim itself -- ~1e-14-class amplitude error against
+an independent numpy f64 oracle -- on a real TPU, where Mosaic's direct
+lowering preserves the EFT arithmetic of ops/pallas_df.
+
+Run on the chip:  python tools/df_verify.py [n] [depth]
+Prints per-circuit max amplitude error and norm drift; exits nonzero if
+either exceeds the df32 budget (1e-12).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("QUEST_PRECISION", "2")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+
+def main():
+    from quest_tpu.ops import pallas_gates as PG
+    from quest_tpu.ops.pallas_df import df_join, df_split
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 14
+    depth = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+    H = np.array([[1, 1], [1, -1]]) / np.sqrt(2)
+    X = np.array([[0, 1], [1, 0]], dtype=complex)
+
+    def rz(th):
+        return np.diag([np.exp(-0.5j * th), np.exp(0.5j * th)])
+
+    rng = np.random.RandomState(5)
+    v = rng.normal(size=(2, 1 << n)) / np.sqrt(2 << n)
+    amps64 = jnp.asarray(v, jnp.float64)
+
+    ops = []
+    lq = PG.local_qubits(n)
+    g = np.random.RandomState(3)
+    for _ in range(depth):
+        for q in range(min(n, lq)):
+            k = g.randint(3)
+            if k == 0:
+                ops.append(("matrix", q, (), (), PG.HashableMatrix(H)))
+            elif k == 1:
+                ops.append(("matrix", q, (), (),
+                            PG.HashableMatrix(rz(g.uniform(0, 6.2)))))
+            else:
+                th = g.uniform(0, 6.2)
+                ops.append(("matrix", q, (), (), PG.HashableMatrix(
+                    np.array([[np.cos(th), -1j * np.sin(th)],
+                              [-1j * np.sin(th), np.cos(th)]]))))
+        for q in range(0, min(n, lq) - 1, 2):
+            ops.append(("matrix", q + 1, (q,), (1,), PG.HashableMatrix(X)))
+    ops = tuple(ops)
+
+    # independent numpy f64 oracle
+    psi = v[0] + 1j * v[1]
+    idx = np.arange(psi.size)
+    for op in ops:
+        _, q, ctrls, states, M = op
+        M = np.asarray(M.arr)
+        sel = np.ones(psi.size, bool)
+        for c, s in zip(ctrls, states):
+            sel &= ((idx >> c) & 1) == s
+        b = (idx >> q) & 1
+        part = psi[idx ^ (1 << q)]
+        out = np.where(b == 0, M[0, 0] * psi + M[0, 1] * part,
+                       M[1, 1] * psi + M[1, 0] * part)
+        psi = np.where(sel, out, psi)
+    oracle = np.stack([psi.real, psi.imag])
+
+    out = np.asarray(df_join(PG.fused_local_run(df_split(amps64),
+                                                n=n, ops=ops)))
+    err = np.abs(out - oracle).max()
+    drift = abs((out ** 2).sum() - (v ** 2).sum())
+    print(f"backend={jax.default_backend()} n={n} ops={len(ops)} "
+          f"max_amp_err={err:.3e} norm_drift={drift:.3e}")
+    budget = 1e-12
+    if jax.default_backend() != "tpu":
+        budget = 1e-7  # XLA-CPU EFT degradation (see module doc)
+    if err > budget or drift > budget:
+        print(f"FAIL: exceeds the df budget {budget}")
+        return 1
+    print("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,15 +79,26 @@ def main():
                          amps, f"floor S={s}")
 
     # --- folded-swap DMA overheads (at the default S) -------------------
-    amps, _ = timeit(run([("matrix", 0, (), (), T)], load_swap_k=8),
-                     amps, "ld=8 S=2048")
-    amps, _ = timeit(run([("matrix", 0, (), (), T)], load_swap_k=8,
-                         store_swap_k=8), amps, "ld=8 st=8 S=2048")
-    amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=8192,
-                         load_swap_k=6), amps, "ld=6 S=8192")
-    amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=8192,
-                         load_swap_k=6, store_swap_k=6),
-                     amps, "ld=6 st=6 S=8192")
+    # guard: a k-bit swap needs k grid bits above the tile (hi + k <= n)
+    from quest_tpu.ops.pallas_gates import LANE_BITS
+
+    def swap_ok(k, sublanes):
+        tb = LANE_BITS + (min(sublanes, 1 << (n - LANE_BITS))
+                          .bit_length() - 1)
+        return tb + k <= n
+
+    if swap_ok(8, 2048):
+        amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=2048,
+                             load_swap_k=8), amps, "ld=8 S=2048")
+        amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=2048,
+                             load_swap_k=8, store_swap_k=8),
+                         amps, "ld=8 st=8 S=2048")
+    if swap_ok(6, 8192):
+        amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=8192,
+                             load_swap_k=6), amps, "ld=6 S=8192")
+        amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=8192,
+                             load_swap_k=6, store_swap_k=6),
+                         amps, "ld=6 st=6 S=8192")
 
     # --- per-op slopes: x4 vs x16 of one kind ---------------------------
     def slope(label, mk, **kw):
