@@ -934,12 +934,20 @@ def _shadow_pop(op: _POp, n: int) -> _POp:
     return _POp(op.kind, targets, controls, op.states, data, op.diag_targets)
 
 
-def transpose_stats(p: FusePlan, shard_qubits: int | None) -> dict:
+def transpose_stats(p: FusePlan, shard_qubits: int | None,
+                    nsv: int | None = None, num_slices: int = 1) -> dict:
     """(collective, local) frame-transpose counts of a pallas plan: a
     relabeling is a cross-device collective exactly when its grid block
     reaches a sharded qubit (>= ``shard_qubits``); None counts all as
-    local (single device)."""
-    coll = loc = 0
+    local (single device).
+
+    With ``nsv`` and ``num_slices`` > 1, collective transposes further
+    split by the interconnect they ride on a slice-major pod topology
+    (parallel.mesh.shard_bit_link): a transpose whose grid block reaches
+    one of the top log2(num_slices) shard bits crosses slices (DCN);
+    the rest stay on the intra-slice ICI axis."""
+    coll = loc = dcn = 0
+    slice_bits = (num_slices - 1).bit_length() if num_slices > 1 else 0
     for i in p.items:
         swaps = []
         if isinstance(i, PallasRun):
@@ -952,9 +960,37 @@ def transpose_stats(p: FusePlan, shard_qubits: int | None) -> dict:
         for k, hi in swaps:
             if shard_qubits is not None and hi + k > shard_qubits:
                 coll += 1
+                if nsv is not None and slice_bits and \
+                        hi + k > nsv - slice_bits:
+                    dcn += 1
             else:
                 loc += 1
-    return {"collective_transposes": coll, "local_transposes": loc}
+    out = {"collective_transposes": coll, "local_transposes": loc}
+    if nsv is not None and slice_bits:
+        out["dcn_transposes"] = dcn
+        out["ici_transposes"] = coll - dcn
+    return out
+
+
+def tape_transpose_stats(tape, shard_qubits: int | None,
+                         nsv: int | None = None,
+                         num_slices: int = 1) -> dict:
+    """:func:`transpose_stats` over an ``as_tape`` tape instead of a
+    FusePlan -- the ONE decoder of the `_apply_pallas_run` /
+    `_apply_frame_swap` tape-entry layouts (used by the bench artifacts
+    and the driver dryrun, which see executed circuits, not plans)."""
+    p = FusePlan()
+    for f, a, _ in tape:
+        name = getattr(f, "__name__", "")
+        if name == "_apply_pallas_run":
+            ops, tb, lk, sk, lh, sh = a
+            p.items.append(PallasRun(tuple(ops), tb, load_swap_k=lk,
+                                     store_swap_k=sk, load_swap_hi=lh,
+                                     store_swap_hi=sh))
+        elif name == "_apply_frame_swap":
+            tb, k, hi = a
+            p.items.append(FrameSwap(tb, k, hi))
+    return transpose_stats(p, shard_qubits, nsv=nsv, num_slices=num_slices)
 
 
 def plan_pallas_sharded(tape, num_qubits: int, dtype, max_qubits: int,

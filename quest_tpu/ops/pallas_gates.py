@@ -758,9 +758,11 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
                     gm = np.int32(c % gm_sz)
                     rest = c // gm_sz
                     return (np.int32(rest // dk), gm, np.int32(rest % dk))
-                # jnp operators: weak-typed python divisors adapt to the
-                # (traced) counter dtype (lax.rem would canonicalise the
-                # literal to i64 under jax x64 and mismatch the i32 c)
+                # np.int32 divisors: bare python ints materialise as i64
+                # constants under jax x64 and Mosaic's convert-lowering
+                # recurses narrowing them; the counter itself is always
+                # i32 (the while_loop carry below)
+                dk, gm_sz = np.int32(dk), np.int32(gm_sz)
                 gm = c % gm_sz
                 rest = c // gm_sz
                 return (rest // dk, gm, rest % dk)
@@ -829,13 +831,11 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
                         outs[slot, i] = planes[i]
 
             def loop(c, carry):
-                # under jax x64 (df kernels) the fori counter
-                # canonicalises to i64, which Mosaic rejects in every
-                # DMA index; lax.convert_element_type (NOT .astype,
-                # which recurses in the pallas tracer) pins it to i32
-                c = jax.lax.convert_element_type(c, jnp.int32)
-                slot = c % 2
-                nxt = (c + 1) % 2
+                # np.int32 literals: a bare `2` materialises as an i64
+                # constant under jax x64, and Mosaic's convert-lowering
+                # recurses infinitely narrowing it (round-5 find)
+                slot = c % np.int32(2)
+                nxt = (c + np.int32(1)) % np.int32(2)
 
                 @pl.when(c + 1 < nchunks)
                 def _():
@@ -852,7 +852,19 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
                 store_dma(slot, c).start()
                 return carry
 
-            jax.lax.fori_loop(0, nchunks, loop, 0)
+            # while_loop with an EXPLICIT i32 carry, not fori_loop: under
+            # jax x64 (the df kernels) fori's counter canonicalises to
+            # i64, and Mosaic's convert-lowering recurses infinitely
+            # trying to narrow it (round-5 find); a strongly-typed i32
+            # carry never needs converting
+            def w_cond(c):
+                return c < np.int32(nchunks)
+
+            def w_body(c):
+                loop(c, 0)
+                return c + np.int32(1)
+
+            jax.lax.while_loop(w_cond, w_body, jnp.asarray(0, jnp.int32))
             for c in range(max(0, nchunks - 2), nchunks):
                 store_dma(c % 2, c).wait()
 
