@@ -178,3 +178,31 @@ def test_fused_circuit_on_sharded_register():
 
     np.testing.assert_allclose(np.asarray(q8.amps), np.asarray(q1.amps),
                                atol=TOL, rtol=TOL)
+
+
+def test_tape_transpose_stats_matches_plan_stats():
+    """The tape-level decoder (used by bench artifacts and the driver
+    dryrun) agrees with transpose_stats over the FusePlan it came from."""
+    import numpy as np
+
+    from __graft_entry__ import _random_layers
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.ops.pallas_gates import local_qubits
+    from quest_tpu.precision import real_dtype
+
+    n, ndev = 20, 8
+    circ = Circuit(n)
+    _random_layers(circ, n, 3)
+    rng = np.random.RandomState(7)
+    for q in range(n):
+        g, _ = np.linalg.qr(rng.randn(2, 2) + 1j * rng.randn(2, 2))
+        circ.unitary(q, g)
+    n_local = n - (ndev.bit_length() - 1)
+    p = fusion.plan_pallas_sharded(tuple(circ._tape), n, real_dtype(), 5,
+                                   local_qubits(n_local), n_local)
+    tape = fusion.as_tape(p)
+    for kwargs in ({}, {"nsv": n, "num_slices": 2}):
+        st_plan = fusion.transpose_stats(p, n_local, **kwargs)
+        st_tape = fusion.tape_transpose_stats(tape, n_local, **kwargs)
+        assert st_plan == st_tape, (st_plan, st_tape)
+    assert fusion.transpose_stats(p, n_local)["collective_transposes"] > 0
