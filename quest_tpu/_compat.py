@@ -1,0 +1,48 @@
+"""JAX version compatibility seam.
+
+The engine targets the current jax API (``jax.shard_map`` with the
+``check_vma`` flag, ``pltpu.CompilerParams``); CI and some build hosts pin
+older releases where those names live elsewhere (``jax.experimental
+.shard_map.shard_map`` with ``check_rep``, ``pltpu.TPUCompilerParams``).
+Every internal module imports the handful of drifting names from here so a
+version bump is a one-file change.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+try:  # jax >= 0.6: top-level export, replication checker flag is check_vma
+    from jax import shard_map as _shard_map
+    _VMA_KW = "check_vma"
+except ImportError:  # older jax: experimental module, flag is check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _VMA_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool | None = None):
+    """``jax.shard_map`` with the replication-checker keyword translated to
+    whatever this jax release calls it."""
+    kwargs = {}
+    if check_vma is not None:
+        kwargs[_VMA_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+
+
+#: Mosaic compiler-params dataclass (renamed TPUCompilerParams ->
+#: CompilerParams upstream)
+CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
+
+def abstract_mesh(shape: tuple, axis_names: tuple):
+    """``jax.sharding.AbstractMesh`` across the ctor-signature change
+    (new: (shape, axis_names); old: one (name, size) shape_tuple)."""
+    from jax.sharding import AbstractMesh
+
+    try:
+        return AbstractMesh(tuple(shape), tuple(axis_names))
+    except (TypeError, ValueError):
+        return AbstractMesh(tuple(zip(axis_names, shape)))

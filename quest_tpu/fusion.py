@@ -35,6 +35,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from . import precision
+from . import telemetry
 
 
 # ---------------------------------------------------------------------------
@@ -786,6 +787,36 @@ class _FramePlannerTwoSlot(_FramePlanner):
             "op feasible in no frame reached the scheduler")
 
 
+def _record_plan_telemetry(p: FusePlan, mode: str, nsv: int,
+                           tile_bits: int | None,
+                           shard_qubits: int | None = None) -> None:
+    """Flight-record a finished plan's shape: item mix, frame-transpose
+    counts, tile geometry. One counter per plan plus a structured event
+    (the per-plan detail bench.py ships in BENCH_DETAIL.json)."""
+    if not telemetry.enabled():
+        return
+    runs = [i for i in p.items if isinstance(i, PallasRun)]
+    folded = sum((1 if r.load_swap_k else 0) + (1 if r.store_swap_k else 0)
+                 for r in runs)
+    explicit = sum(isinstance(i, FrameSwap) for i in p.items)
+    telemetry.inc("fusion_plans_total", mode=mode)
+    telemetry.inc("fusion_fused_gates_total", p.num_fused_gates, mode=mode)
+    telemetry.inc("fusion_barriers_total", p.num_barriers, mode=mode)
+    telemetry.inc("fusion_pallas_runs_total", len(runs), mode=mode)
+    telemetry.inc("fusion_frame_transposes_total", folded + explicit,
+                  mode=mode)
+    telemetry.event(
+        "fusion.plan", mode=mode, nsv=nsv, tile_bits=tile_bits,
+        items=len(p.items), pallas_runs=len(runs),
+        dense_blocks=sum(isinstance(i, FusedBlock) for i in p.items),
+        diag_blocks=sum(isinstance(i, DiagBlock) for i in p.items),
+        frame_transposes=folded + explicit,
+        ops_per_run=[len(r.ops) for r in runs],
+        fused_gates=p.num_fused_gates, barriers=p.num_barriers,
+        **(transpose_stats(p, shard_qubits)
+           if shard_qubits is not None else {}))
+
+
 def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
          max_diag_qubits: int = 12, pallas_tile_bits: int | None = None,
          is_density: bool = False,
@@ -809,10 +840,16 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
     more high qubits for the frame machinery to relabel (the round-2 build
     excluded density tapes entirely; VERDICT r2 missing #1).
     """
+    nsv = (2 if is_density else 1) * num_qubits
     if pallas_tile_bits is not None:
-        return _plan_pallas(tape, num_qubits, dtype, max_qubits,
-                            pallas_tile_bits, is_density=is_density,
-                            shard_boundary=shard_boundary)
+        with telemetry.span("fusion.plan", mode="pallas"):
+            p = _plan_pallas(tape, num_qubits, dtype, max_qubits,
+                             pallas_tile_bits, is_density=is_density,
+                             shard_boundary=shard_boundary)
+        _record_plan_telemetry(p, "pallas", nsv, pallas_tile_bits)
+        return p
+    import time as _time
+    _t0 = _time.perf_counter()
     out = FusePlan()
     cur = None  # None | FusedBlock | DiagBlock (mutable accumulators)
 
@@ -883,6 +920,9 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
                 add_dense(ev)
             out.num_fused_gates += 1
     flush()
+    telemetry.observe("fusion.plan_seconds", _time.perf_counter() - _t0,
+                      mode="dense")
+    _record_plan_telemetry(out, "dense", nsv, None)
     return out
 
 
@@ -1009,14 +1049,19 @@ def plan_pallas_sharded(tape, num_qubits: int, dtype, max_qubits: int,
         # otherwise the aligned tiling is identical and the second full
         # spy-replay of the tape (the dominant trace-time cost) is waste
         boundaries.append(n_local)
-    cands = [
-        _plan_pallas(tape, num_qubits, dtype, max_qubits, tile_bits,
-                     is_density=is_density, shard_boundary=b,
-                     score_shard_qubits=n_local)
-        for b in boundaries
-    ]
-    return min(cands, key=lambda p: (
-        transpose_stats(p, n_local)["collective_transposes"], len(p.items)))
+    with telemetry.span("fusion.plan", mode="pallas_sharded"):
+        cands = [
+            _plan_pallas(tape, num_qubits, dtype, max_qubits, tile_bits,
+                         is_density=is_density, shard_boundary=b,
+                         score_shard_qubits=n_local)
+            for b in boundaries
+        ]
+        best = min(cands, key=lambda p: (
+            transpose_stats(p, n_local)["collective_transposes"],
+            len(p.items)))
+    _record_plan_telemetry(best, "pallas_sharded", nsv, tile_bits,
+                           shard_qubits=n_local)
+    return best
 
 
 def _plan_pallas(tape, num_qubits: int, dtype, max_qubits: int,
@@ -1172,6 +1217,7 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
 
     def pre_swap():
         if load_swap_k:
+            telemetry.inc("pallas_pass_total", kind="frame_swap")
             qureg.put(swap_bit_blocks(
                 qureg.amps, n=nsv, lo1=tile_bits - load_swap_k,
                 lo2=tile_bits if load_swap_hi is None else load_swap_hi,
@@ -1179,6 +1225,7 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
 
     def post_swap():
         if store_swap_k:
+            telemetry.inc("pallas_pass_total", kind="frame_swap")
             qureg.put(swap_bit_blocks(
                 qureg.amps, n=nsv, lo1=tile_bits - store_swap_k,
                 lo2=tile_bits if store_swap_hi is None else store_swap_hi,
@@ -1197,6 +1244,7 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
             qureg.put(new)
             post_swap()
             return
+        telemetry.inc("engine_fallback_total", reason="shard_map_unsupported")
         if load_swap_k:  # swap already applied; replay ops via the engine
             _apply_ops_via_engine(qureg, ops)
             post_swap()
@@ -1210,6 +1258,11 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                 qureg.put(new)
                 post_swap()
                 return
+            telemetry.inc("engine_fallback_total",
+                          reason="shard_map_unsupported")
+        else:
+            telemetry.inc("engine_fallback_total",
+                          reason="explicit_scheduler")
         _apply_ops_via_engine(qureg, ops)
         post_swap()
         return
@@ -1226,11 +1279,28 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
             from .ops.pallas_df import (DF_MAX_OPS, DF_SUBLANES, df_join,
                                         df_split)
 
+            lq_df = PG.local_qubits(nsv, DF_SUBLANES)
+            if any(q >= lq_df for op in ops
+                   for q in PG.op_dense_targets(op)):
+                # a plan built with non-DF tile geometry (e.g.
+                # Circuit.fused(dtype=np.float32) replayed on an f64
+                # register) can carry dense targets in [lq_df, plan
+                # tile_bits); the engine fallback -- not a runtime
+                # ValueError from fused_local_run -- is the contract for
+                # f64 registers (ADVICE round 5)
+                telemetry.inc("engine_fallback_total",
+                              reason="df_tile_mismatch")
+                pre_swap()
+                _apply_ops_via_engine(qureg, ops)
+                post_swap()
+                return
             k_max = max(load_swap_k, store_swap_k)
             foldable = (k_max > 0
                         and tile_bits == PG.local_qubits(nsv, DF_SUBLANES)
                         and tile_bits - PG.LANE_BITS - k_max >= 3)
             if k_max and not foldable:
+                telemetry.inc("engine_fallback_total",
+                              reason="swap_not_foldable")
                 pre_swap()
             planes = df_split(qureg.amps)
             # Mosaic compile time is superlinear in op count and df ops
@@ -1240,6 +1310,11 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
             # exceeded 9 minutes; 8-op kernels compile in seconds)
             chunks = ([ops[i:i + DF_MAX_OPS]
                        for i in range(0, len(ops), DF_MAX_OPS)] or [ops])
+            if len(chunks) > 1:
+                # each extra chunk is one extra HBM pass the plan did not
+                # price in -- visible, not silent (ISSUE 1 tentpole)
+                telemetry.inc("engine_fallback_total", len(chunks) - 1,
+                              reason="df_max_ops_split")
             last = len(chunks) - 1
             for ci, chunk in enumerate(chunks):
                 planes = fused_local_run(
@@ -1258,6 +1333,7 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
             return
         # sharded f64 (or sub-tile registers): XLA engine replay (with
         # explicit frame-swap passes) remains the documented policy
+        telemetry.inc("engine_fallback_total", reason="f64_engine")
         pre_swap()
         _apply_ops_via_engine(qureg, ops)
         post_swap()
@@ -1270,6 +1346,7 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                 and tile_bits == PG.local_qubits(nsv)
                 and tile_bits - PG.LANE_BITS - k_max >= 3)
     if k_max and not foldable:
+        telemetry.inc("engine_fallback_total", reason="swap_not_foldable")
         pre_swap()
     qureg.put(fused_local_run(
         qureg.amps, n=nsv, ops=ops,
@@ -1309,8 +1386,9 @@ def _run_pallas_sharded(qureg, ops: tuple, mesh):
     analogue of the reference running its local kernel per rank between
     exchanges (QuEST_cpu_distributed.c:870-905)."""
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map
 
     from .environment import AMP_AXIS
     from .ops import pallas_gates as PG
@@ -1354,6 +1432,7 @@ def _apply_ops_via_engine(qureg, ops: tuple) -> None:
     from .parallel import scheduler as _dist
 
     nsv = qureg.num_qubits_in_state_vec
+    telemetry.inc("engine_replayed_ops_total", len(ops))
     sched = _dist.active()
     apply_m = sched.apply_matrix if sched else K.apply_matrix
     apply_d = sched.apply_diagonal if sched else D.apply_diagonal
@@ -1467,6 +1546,7 @@ def _apply_frame_swap(qureg, tile_bits: int, k: int,
     the sharded qubits)."""
     from .ops.pallas_gates import swap_bit_blocks
 
+    telemetry.inc("pallas_pass_total", kind="frame_swap")
     qureg.put(swap_bit_blocks(qureg.amps, n=qureg.num_qubits_in_state_vec,
                               lo1=tile_bits - k,
                               lo2=tile_bits if hi is None else hi, k=k))

@@ -16,9 +16,19 @@ error-free-transform arithmetic:
   the 2^12+1 split factor makes both halves exact in f32),
 - gate-matrix constants pre-split on the host at full f64 precision.
 
-Result: ~48-bit effective mantissa (unit error ~2^-47 per op vs f64's
-2^-53), executed as pure f32 VPU work inside the same fused single-HBM-pass
-kernels as the f32 path (ops/pallas_gates). This is the precision analogue
+Result: ~48-bit effective mantissa -- TYPICAL/OBSERVED unit error ~2^-47
+per op vs f64's 2^-53 (tools/df_verify on-chip: max amplitude error
+6.6e-16 at 10q). This is not a uniform worst-case bound: ``df_add`` is the
+"sloppy" double-double addition (one TwoSum on the hi components, the lo
+components folded in before a single FastTwoSum), and under NEAR-
+CANCELLATION of the hi components its RELATIVE error is unbounded by
+2^-47 -- the classic Dekker caveat; the accurate variant (a second TwoSum
+for the lo sum) would restore a uniform bound at ~1.4x the add cost.
+Gate applications are unitary mixes whose coefficients are bounded by 1,
+so the measured workloads sit at the typical figure, but consumers needing
+a guaranteed worst case should treat the claim as empirical. Executed as
+pure f32 VPU work inside the same fused single-HBM-pass kernels as the
+f32 path (ops/pallas_gates). This is the precision analogue
 of the bf16x3 trick already used for the f32 zone dots: synthesise the wide
 type from the narrow one the hardware is fast at.
 

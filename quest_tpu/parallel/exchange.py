@@ -27,8 +27,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import telemetry
+from .._compat import shard_map
 
 from ..environment import AMP_AXIS
 from ..ops import apply as K
@@ -94,6 +97,8 @@ def dist_apply_matrix1(amps, matrix, *, n: int, target: int,
     scheme. Local target with (possibly) sharded controls: no communication.
     """
     nl = local_qubit_count(n, mesh)
+    if target >= nl:
+        telemetry.inc("exchange_calls_total", kind="pair_exchange")
     lc, ls, sc, ss = _split_controls(controls, control_states, nl)
     mr, mi = matrix[0], matrix[1]
     if conj:
@@ -169,6 +174,8 @@ def dist_apply_x(amps, *, n: int, targets: tuple[int, ...],
     lc, ls, sc, ss = _split_controls(controls, control_states, nl)
     local_t = tuple(t for t in targets if t < nl)
     shard_t = tuple(t for t in targets if t >= nl)
+    if shard_t:
+        telemetry.inc("exchange_calls_total", kind="x_permute")
 
     def kernel(chunk):
         own = chunk
@@ -264,6 +271,7 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
     source = tuple(source)
     if all(source[q] == q for q in range(n)):
         return amps
+    telemetry.inc("exchange_calls_total", kind="grouped_permute")
     rho_src, Q_c, L_in, L_out, dest = _permute_decompose(n, source, nl)
     m = len(Q_c)
     size = mesh.shape[AMP_AXIS] if mesh is not None and mesh.size > 1 else 1
@@ -419,6 +427,10 @@ def dist_swap(amps, *, n: int, qb1: int, qb2: int, mesh: Mesh):
     """
     nl = local_qubit_count(n, mesh)
     lo, hi = min(qb1, qb2), max(qb1, qb2)
+    if hi >= nl:
+        telemetry.inc("exchange_calls_total",
+                      kind=("swap_rank_permute" if lo >= nl
+                            else "swap_odd_parity"))
 
     def kernel(chunk):
         own = chunk

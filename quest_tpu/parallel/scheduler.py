@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 
 from jax.sharding import Mesh
 
+from .. import telemetry
 from . import exchange as X
 from .mesh import local_qubit_count
 
@@ -100,15 +101,22 @@ class DistributedScheduler:
         "reconcile_swap_equiv_chunks": 0.0,
         "ici_chunks": 0.0, "dcn_chunks": 0.0})
 
-    def _count_comm(self, n: int, qubit: int, chunks: float) -> None:
+    def _count_comm(self, n: int, qubit: int, chunks: float,
+                    kind: str = "other") -> None:
         """Attribute ``chunks`` of traffic to the interconnect the comm op
         on sharded physical ``qubit`` rides (slice-major device order: low
-        shard bits = ICI chip axis, top log2(num_slices) bits = DCN)."""
+        shard bits = ICI chip axis, top log2(num_slices) bits = DCN), and
+        flight-record the same units per collective ``kind`` -- the
+        telemetry series ``comm_chunk_units_total{kind,link}`` sums to
+        exactly :func:`comm_chunks` of this plan's stats (asserted by
+        tests/test_telemetry.py against the plan_circuit model)."""
         from .mesh import shard_bit_link
 
         link = shard_bit_link(n, self.mesh, self.num_slices, qubit)
         if link is not None:
             self.stats[f"{link}_chunks"] += chunks
+        telemetry.inc("comm_chunk_units_total", chunks, kind=kind,
+                      link=link or "local")
 
     def __post_init__(self):
         self.deferring = False
@@ -249,7 +257,8 @@ class DistributedScheduler:
                 if price:
                     self.stats["reconcile_swaps"] += 1
                     self.stats["reconcile_chunks"] += price
-                    self._count_comm(n, max(a, b), price)
+                    self._count_comm(n, max(a, b), price,
+                                     kind="reconciliation")
                 else:
                     self.stats["local"] += 1
                 amps = X.dist_swap(amps, n=n, qb1=a, qb2=b, mesh=self.mesh)
@@ -267,12 +276,13 @@ class DistributedScheduler:
         if cross:
             share = 2.0 * (1.0 - 0.5 ** len(cross)) / len(cross)
             for q in cross:
-                self._count_comm(n, q, share)
+                self._count_comm(n, q, share, kind="reconciliation")
         if cstats["relabel_ppermute"]:
             moved = [q for q in range(nl, n)
                      if source[q] >= nl and source[q] != q]
             for q in moved:
-                self._count_comm(n, q, 2.0 / len(moved))
+                self._count_comm(n, q, 2.0 / len(moved),
+                                 kind="reconciliation")
         amps = X.dist_permute_bits(amps, n=n, source=source, mesh=self.mesh)
         self._pos = list(range(n))
         self._occ = list(range(n))
@@ -312,7 +322,7 @@ class DistributedScheduler:
         relocation = {}
         for s, f in zip(shard, free):
             self.stats["relocation_swaps"] += 1
-            self._count_comm(n, s, 1.0)
+            self._count_comm(n, s, 1.0, kind="dist_swap")
             amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh)
             if self.deferring:
                 self._swap_positions(f, s)
@@ -347,7 +357,8 @@ class DistributedScheduler:
                                                   support, on_fail="none")
             if relocation is None:
                 self.stats["pair_exchanges"] += 1
-                self._count_comm(n, p_targets[0], 2.0)
+                self._count_comm(n, p_targets[0], 2.0,
+                                 kind="pair_exchange")
                 return X.dist_apply_matrix1(
                     amps, matrix, n=n, target=p_targets[0],
                     controls=p_controls,
@@ -373,7 +384,7 @@ class DistributedScheduler:
         if not self.deferring:
             for s, f in relocation.items():
                 self.stats["relocation_swaps"] += 1
-                self._count_comm(n, s, 1.0)
+                self._count_comm(n, s, 1.0, kind="dist_swap")
                 amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh)
         return amps
 
@@ -405,7 +416,8 @@ class DistributedScheduler:
             self.stats["local"] += 1
         else:
             self.stats["rank_permutes"] += 1
-            self._count_comm(n, max(t for t in p_targets if t >= nl), 2.0)
+            self._count_comm(n, max(t for t in p_targets if t >= nl), 2.0,
+                             kind="grouped_permute")
         return X.dist_apply_x(amps, n=n, targets=p_targets,
                               controls=p_controls,
                               control_states=tuple(control_states),
@@ -421,6 +433,7 @@ class DistributedScheduler:
             p1, p2 = self._pos[qb1], self._pos[qb2]
             self._swap_positions(p1, p2)
             self.stats["virtual_swaps"] += 1
+            telemetry.inc("comm_ops_total", kind="virtual_swap")
             return amps
         p1, p2 = self._map(n, (qb1, qb2))
         nl = local_qubit_count(n, self.mesh)
@@ -429,10 +442,10 @@ class DistributedScheduler:
             self.stats["local"] += 1
         elif min(p1, p2) >= nl:
             self.stats["rank_permutes"] += 1
-            self._count_comm(n, max(p1, p2), 2.0)
+            self._count_comm(n, max(p1, p2), 2.0, kind="grouped_permute")
         else:
             self.stats["relocation_swaps"] += 1
-            self._count_comm(n, max(p1, p2), 1.0)
+            self._count_comm(n, max(p1, p2), 1.0, kind="dist_swap")
         return X.dist_swap(amps, n=n, qb1=p1, qb2=p2, mesh=self.mesh)
 
     # -- diagonal family (always comm-free) ---------------------------------

@@ -6,12 +6,20 @@ right tool is the XLA profiler; this module packages it plus lightweight
 host-side op accounting so users can see where a circuit spends its time
 without leaving the QuEST-style API.
 
+Since round 6 this module is a thin veneer over :mod:`quest_tpu.telemetry`
+(the engine flight recorder): every instrumented call lands in the
+process-global registry under ``api_call_total{op=...}`` /
+``api_call_seconds{op=...}`` in addition to the local :class:`OpStats`, so
+one :func:`quest_tpu.telemetry.snapshot` carries the L5 accounting next to
+the engine-internal metrics (fusion plans, comm chunk-units, Pallas passes).
+
 - :func:`trace` -- context manager around ``jax.profiler`` producing a
-  Perfetto/TensorBoard trace directory.
+  Perfetto/TensorBoard trace directory (wrapped in a telemetry span).
 - :class:`OpStats` / :func:`instrument` -- count and wall-time every L5 API
   call on a register (eager path) or every block of a Circuit run.
 - :func:`device_memory_report` -- live HBM usage per buffer, the analogue of
-  the reference's createQureg memory documentation (QuEST.h:423-430).
+  the reference's createQureg memory documentation (QuEST.h:423-430); also
+  exports the figures as telemetry gauges.
 """
 
 from __future__ import annotations
@@ -23,6 +31,8 @@ from dataclasses import dataclass, field
 
 import jax
 
+from . import telemetry
+
 __all__ = ["trace", "OpStats", "instrument", "device_memory_report"]
 
 
@@ -33,16 +43,22 @@ def trace(log_dir: str):
         with quest_tpu.profiling.trace("/tmp/qtrace"):
             circuit.run(qureg)
     """
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    with telemetry.span("profiling.trace", log_dir=log_dir):
+        jax.profiler.start_trace(log_dir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
 
 
 @dataclass
 class OpStats:
-    """Host-side per-op accounting collected by :func:`instrument`."""
+    """Host-side per-op accounting collected by :func:`instrument`.
+
+    A local mirror of the registry series the same instrumentation writes
+    (``api_call_total`` / ``api_call_seconds``): the dataclass scopes the
+    numbers to ONE instrument() block, while the registry accumulates
+    process-wide for snapshot/export."""
     counts: dict = field(default_factory=lambda: defaultdict(int))
     seconds: dict = field(default_factory=lambda: defaultdict(float))
 
@@ -59,7 +75,9 @@ def instrument(stats: OpStats | None = None):
 
     Host-side wall time includes dispatch but not necessarily device drain
     (JAX is async); use :func:`trace` for true device timelines. Yields the
-    OpStats, restoring the un-instrumented functions on exit."""
+    OpStats, restoring the un-instrumented functions on exit. Every call is
+    also recorded into the telemetry registry (``api_call_total{op=}``,
+    ``api_call_seconds{op=}``)."""
     import quest_tpu as qt
 
     stats = stats or OpStats()
@@ -71,8 +89,11 @@ def instrument(stats: OpStats | None = None):
             try:
                 return fn(*args, **kwargs)
             finally:
+                dt = time.perf_counter() - t0
                 stats.counts[name] += 1
-                stats.seconds[name] += time.perf_counter() - t0
+                stats.seconds[name] += dt
+                telemetry.inc("api_call_total", op=name)
+                telemetry.observe("api_call_seconds", dt, op=name)
         timed.__name__ = name
         return timed
 
@@ -97,7 +118,8 @@ def instrument(stats: OpStats | None = None):
 
 
 def device_memory_report(device=None) -> str:
-    """Per-buffer live HBM usage on ``device`` (default: first device)."""
+    """Per-buffer live HBM usage on ``device`` (default: first device);
+    the figures also land as ``hbm_bytes{...}`` telemetry gauges."""
     device = device or jax.devices()[0]
     try:
         stats = device.memory_stats()
@@ -108,5 +130,9 @@ def device_memory_report(device=None) -> str:
     used = stats.get("bytes_in_use", 0)
     limit = stats.get("bytes_limit", 0)
     peak = stats.get("peak_bytes_in_use", 0)
+    kind = device.device_kind
+    telemetry.set_gauge("hbm_bytes", used, state="in_use", device=kind)
+    telemetry.set_gauge("hbm_bytes", peak, state="peak", device=kind)
+    telemetry.set_gauge("hbm_bytes", limit, state="limit", device=kind)
     return (f"{device.device_kind}: {used/2**20:.1f} MiB in use, "
             f"peak {peak/2**20:.1f} MiB, limit {limit/2**20:.1f} MiB")

@@ -216,6 +216,9 @@ def copySubstateToGPU(qureg: Qureg, start_ind: int, num_amps: int) -> None:
     validation.validate_num_amps(qureg, start_ind, num_amps, func)
     mirror = _host_mirror(qureg)
     patch = jnp.asarray(mirror[:, start_ind:start_ind + num_amps])
-    new = jax.lax.dynamic_update_slice_in_dim(qureg.amps, patch, start_ind, axis=1)
+    # static-index .at[].set, not dynamic_update_slice: the indices are
+    # host ints, and on a sharded operand some jaxlib releases lower the
+    # dynamic form with mixed s64/s32 index clamps (hlo verifier error)
+    new = qureg.amps.at[:, start_ind:start_ind + num_amps].set(patch)
     new = jax.device_put(new, qureg.amps.sharding)
     qureg.put(new)

@@ -1,0 +1,419 @@
+"""Engine flight recorder: one metrics spine for every layer.
+
+The reference simulator ships no timers, counters, or trace hooks (SURVEY.md
+section 5 -- its only introspection is reportQuregParams and the QASM log),
+and until round 6 this build's own perf evidence was scattered across ad-hoc
+dicts (scheduler.stats), bench-only printouts (per-pass floors) and silent
+fast-path bailouts nobody could see. This module is the single registry all
+of them report into and every artifact is derived from:
+
+- **Counters / gauges / histograms**, labeled Prometheus-style
+  (``inc("engine_fallback_total", reason="df_tile_mismatch")``) -- the
+  fusion planner, the distributed scheduler, the exchange kernels and the
+  Pallas dispatch layer all record here (see the instrumentation map in
+  docs/observability.md).
+- **Nested host-side spans** with monotonic timing
+  (``with span("fusion.plan", qubits=26): ...``): each completed span
+  aggregates into the registry (count / total_s / max_s) and, optionally,
+  streams one JSONL event (``QUEST_TELEMETRY_JSONL=/path`` or
+  :func:`export_jsonl`).
+- **Snapshots**: :func:`snapshot` returns the whole registry as one nested
+  JSON-ready dict -- ``bench.py`` embeds it in ``BENCH_DETAIL.json`` so the
+  per-pass / comm-volume / fallback story ships with every headline number.
+
+Semantics notes:
+
+- Everything here is HOST-side accounting. Inside ``jax.jit`` the
+  instrumented code runs once per *trace*, so counters count traced work
+  (plan shape, comm chunk-units of the compiled program), not per-execution
+  device work; span durations around jitted calls measure dispatch (plus
+  compilation on the first call), not device drain.
+- **Zero overhead when disabled**: ``QUEST_TELEMETRY=0`` rebinds the whole
+  public surface to no-op stubs at import (a disabled process records
+  nothing and allocates nothing). In-process, :func:`disabled` flips the
+  same guard temporarily -- tests use it to assert bit-identical results.
+- Thread-safe: one lock around the registry maps, a thread-local span
+  stack, so instrumented code may run from any thread.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "enabled", "disabled", "inc", "set_gauge", "observe", "span", "event",
+    "counter_value", "counter_total", "counters", "snapshot", "reset",
+    "export_jsonl", "events",
+]
+
+#: import-time master switch; QUEST_TELEMETRY=0 swaps in the no-op stubs
+_ENV_ENABLED = os.environ.get("QUEST_TELEMETRY", "1").strip().lower() \
+    not in ("0", "false", "off")
+
+#: if set, every completed span / event streams one JSON line here
+_JSONL_ENV = "QUEST_TELEMETRY_JSONL"
+
+#: cap on the in-memory event ring (oldest dropped first): a flight
+#: recorder must never grow without bound inside a long-lived server
+_MAX_EVENTS = 1 << 16
+
+
+def _label_key(labels: dict) -> str:
+    """Canonical ``{k=v,...}`` suffix (sorted keys; '' when unlabeled)."""
+    if not labels:
+        return ""
+    items = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return "{" + items + "}"
+
+
+def _series_key(name: str, labels: dict) -> str:
+    return name + _label_key(labels)
+
+
+class _SpanHandle:
+    """One live span: context manager recording a monotonic duration into
+    the registry on exit (and one JSONL event). Nesting is tracked via the
+    registry's thread-local stack; ``path`` is the '/'-joined ancestry."""
+
+    __slots__ = ("_reg", "name", "labels", "_t0", "path", "duration_s")
+
+    def __init__(self, reg: "MetricsRegistry", name: str, labels: dict):
+        self._reg = reg
+        self.name = name
+        self.labels = labels
+        self._t0 = 0.0
+        self.path = name
+        self.duration_s = None
+
+    def __enter__(self):
+        stack = self._reg._span_stack()
+        if stack:
+            self.path = stack[-1].path + "/" + self.name
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.duration_s = time.perf_counter() - self._t0
+        stack = self._reg._span_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        self._reg._finish_span(self)
+        return False
+
+
+class _NullSpan:
+    """Shared no-op span for the disabled path (no allocation per call)."""
+
+    __slots__ = ()
+    duration_s = None
+    path = ""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class MetricsRegistry:
+    """Process-global metric store; all module-level helpers delegate to
+    one shared instance (:data:`REGISTRY`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.enabled = _ENV_ENABLED
+        self._jsonl_fh = None
+        self._jsonl_path = os.environ.get(_JSONL_ENV)
+        self._reset_locked()
+
+    # -- storage ------------------------------------------------------------
+
+    def _reset_locked(self):
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, dict] = {}
+        self._spans: dict[str, dict] = {}
+        self._events: list[dict] = []
+
+    def reset(self) -> None:
+        """Drop every recorded metric and event (tests, bench sections)."""
+        with self._lock:
+            self._reset_locked()
+
+    def _span_stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    # -- recording ----------------------------------------------------------
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        """Add ``value`` to the counter series ``name{labels}``."""
+        if not self.enabled:
+            return
+        key = _series_key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        """Set the gauge series ``name{labels}`` to ``value``."""
+        if not self.enabled:
+            return
+        key = _series_key(name, labels)
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        """Record one observation into the histogram ``name{labels}``
+        (count / sum / min / max aggregate -- enough to derive rates and
+        spot outliers without shipping raw samples)."""
+        if not self.enabled:
+            return
+        key = _series_key(name, labels)
+        v = float(value)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                self._hists[key] = {"count": 1, "sum": v, "min": v, "max": v}
+            else:
+                h["count"] += 1
+                h["sum"] += v
+                h["min"] = min(h["min"], v)
+                h["max"] = max(h["max"], v)
+
+    def span(self, name: str, **labels):
+        """Context manager timing a nested host-side region."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _SpanHandle(self, name, labels)
+
+    def event(self, name: str, **fields) -> None:
+        """Append one raw flight-recorder event (JSONL-exportable)."""
+        if not self.enabled:
+            return
+        self._append_event({"kind": "event", "name": name, "t": time.time(),
+                            **fields})
+
+    def _finish_span(self, sp: _SpanHandle) -> None:
+        key = _series_key(sp.name, sp.labels)
+        with self._lock:
+            agg = self._spans.get(key)
+            if agg is None:
+                self._spans[key] = {"count": 1, "total_s": sp.duration_s,
+                                    "max_s": sp.duration_s}
+            else:
+                agg["count"] += 1
+                agg["total_s"] += sp.duration_s
+                agg["max_s"] = max(agg["max_s"], sp.duration_s)
+        self._append_event({"kind": "span", "name": sp.name, "t": time.time(),
+                            "path": sp.path, "dur_s": round(sp.duration_s, 9),
+                            **({"labels": sp.labels} if sp.labels else {})})
+
+    def _append_event(self, ev: dict) -> None:
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > _MAX_EVENTS:
+                del self._events[: len(self._events) - _MAX_EVENTS]
+        path = self._jsonl_path
+        if path:
+            self._stream_jsonl(ev, path)
+
+    def _stream_jsonl(self, ev: dict, path: str) -> None:
+        try:
+            if self._jsonl_fh is None:
+                self._jsonl_fh = open(path, "a", buffering=1)
+            self._jsonl_fh.write(json.dumps(ev) + "\n")
+        except OSError:  # a broken sink must never take the engine down
+            self._jsonl_path = None
+
+    # -- reading ------------------------------------------------------------
+
+    def counter_value(self, name: str, **labels) -> float:
+        """Value of one exact counter series (0.0 if never incremented)."""
+        with self._lock:
+            return self._counters.get(_series_key(name, labels), 0.0)
+
+    def counter_total(self, name: str) -> float:
+        """Sum of a counter across ALL label series."""
+        prefix = name + "{"
+        with self._lock:
+            return sum(v for k, v in self._counters.items()
+                       if k == name or k.startswith(prefix))
+
+    def counters(self, name: str) -> dict:
+        """{label-suffix: value} for every series of ``name`` ('' when
+        unlabeled) -- the per-reason breakdown tests assert against."""
+        prefix = name + "{"
+        out = {}
+        with self._lock:
+            for k, v in self._counters.items():
+                if k == name:
+                    out[""] = v
+                elif k.startswith(prefix):
+                    out[k[len(name):]] = v
+        return out
+
+    def snapshot(self, prefix: str | None = None) -> dict:
+        """The whole registry as one JSON-ready dict; ``prefix`` filters
+        series names. Histogram/span sums are rounded to keep artifacts
+        compact and diff-stable."""
+        def keep(k):
+            return prefix is None or k.startswith(prefix)
+
+        def num(v):
+            return int(v) if float(v).is_integer() else round(v, 6)
+
+        with self._lock:
+            return {
+                "counters": {k: num(v)
+                             for k, v in sorted(self._counters.items())
+                             if keep(k)},
+                "gauges": {k: round(v, 6)
+                           for k, v in sorted(self._gauges.items())
+                           if keep(k)},
+                "histograms": {
+                    k: {"count": h["count"], "sum": round(h["sum"], 6),
+                        "min": round(h["min"], 6), "max": round(h["max"], 6)}
+                    for k, h in sorted(self._hists.items()) if keep(k)},
+                "spans": {
+                    k: {"count": a["count"],
+                        "total_s": round(a["total_s"], 6),
+                        "max_s": round(a["max_s"], 6)}
+                    for k, a in sorted(self._spans.items()) if keep(k)},
+            }
+
+    def events(self) -> list:
+        """A copy of the in-memory event ring (most recent last)."""
+        with self._lock:
+            return list(self._events)
+
+    def export_jsonl(self, path: str, clear: bool = False) -> int:
+        """Write every buffered event as one JSON line each; returns the
+        number written. ``clear`` drops the buffer afterwards."""
+        with self._lock:
+            evs = list(self._events)
+            if clear:
+                self._events = []
+        with open(path, "w") as fh:
+            for ev in evs:
+                fh.write(json.dumps(ev) + "\n")
+        return len(evs)
+
+
+#: the process-global registry every instrumented layer reports into
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# module-level convenience surface (what instrumented code imports)
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    """True when telemetry is recording (QUEST_TELEMETRY != 0 and not
+    inside a :func:`disabled` block)."""
+    return REGISTRY.enabled
+
+
+@contextlib.contextmanager
+def disabled():
+    """Temporarily disable all recording in-process (tests use this to
+    assert the instrumented paths are result-identical without telemetry;
+    for true zero-overhead use QUEST_TELEMETRY=0 at process start)."""
+    prev = REGISTRY.enabled
+    REGISTRY.enabled = False
+    try:
+        yield
+    finally:
+        REGISTRY.enabled = prev
+
+
+def inc(name: str, value: float = 1.0, **labels) -> None:
+    REGISTRY.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    REGISTRY.set_gauge(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    REGISTRY.observe(name, value, **labels)
+
+
+def span(name: str, **labels):
+    return REGISTRY.span(name, **labels)
+
+
+def event(name: str, **fields) -> None:
+    REGISTRY.event(name, **fields)
+
+
+def counter_value(name: str, **labels) -> float:
+    return REGISTRY.counter_value(name, **labels)
+
+
+def counter_total(name: str) -> float:
+    return REGISTRY.counter_total(name)
+
+
+def counters(name: str) -> dict:
+    return REGISTRY.counters(name)
+
+
+def snapshot(prefix: str | None = None) -> dict:
+    return REGISTRY.snapshot(prefix)
+
+
+def reset() -> None:
+    REGISTRY.reset()
+
+
+def export_jsonl(path: str, clear: bool = False) -> int:
+    return REGISTRY.export_jsonl(path, clear)
+
+
+def events() -> list:
+    return REGISTRY.events()
+
+
+# ---------------------------------------------------------------------------
+# QUEST_TELEMETRY=0: swap the whole surface for no-op stubs at import, so a
+# disabled process pays nothing beyond one module import (no allocation, no
+# lock, no dict lookups -- the "zero-overhead-when-disabled" guarantee)
+# ---------------------------------------------------------------------------
+
+if not _ENV_ENABLED:  # pragma: no cover - exercised via subprocess test
+    def _noop(*args, **kwargs):
+        return None
+
+    def _zero(*args, **kwargs):
+        return 0.0
+
+    def _empty_dict(*args, **kwargs):
+        return {}
+
+    def _null_span(*args, **kwargs):
+        return _NULL_SPAN
+
+    inc = set_gauge = observe = event = reset = _noop  # noqa: F811
+    span = _null_span                                  # noqa: F811
+    counter_value = counter_total = _zero              # noqa: F811
+    counters = _empty_dict                             # noqa: F811
+
+    def snapshot(prefix=None):                         # noqa: F811
+        return {"counters": {}, "gauges": {}, "histograms": {}, "spans": {}}
+
+    def export_jsonl(path, clear=False):               # noqa: F811
+        return 0
+
+    def events():                                      # noqa: F811
+        return []

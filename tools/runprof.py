@@ -1,7 +1,13 @@
 """Per-item profiling of the two-frame plan: times each PallasRun and
 FrameSwap of the bench circuit individually (loop-inside-jit), and prints
 the op composition of each run -- the breakdown that tells where a block's
-milliseconds go."""
+milliseconds go.
+
+Each item's timing is also recorded as a telemetry span
+(``runprof.item{index,kind}``), and the run ends with the registry's
+compile-seconds / pass-count snapshot -- the same series bench.py ships in
+BENCH_DETAIL.json, so a runprof session and a bench artifact are directly
+comparable."""
 
 from __future__ import annotations
 
@@ -43,7 +49,7 @@ def timeit(fn, amps, reps=10):
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 26
     from __graft_entry__ import _random_layers
-    from quest_tpu import fusion
+    from quest_tpu import fusion, telemetry
     from quest_tpu.circuits import Circuit
     from quest_tpu.ops.pallas_gates import (_fold_zone_ops, fused_local_run,
                                             local_qubits, swap_bit_blocks)
@@ -80,19 +86,33 @@ def main():
                     return fused_local_run(x, n=n, ops=ops,
                                            load_swap_k=lk, store_swap_k=sk,
                                            load_swap_hi=lh, store_swap_hi=sh)
-            dt, amps = timeit(run, amps)
+            with telemetry.span("runprof.item", index=i, kind="run"):
+                dt, amps = timeit(run, amps)
+            telemetry.set_gauge("runprof.item_ms", dt * 1e3, index=i,
+                                kind="run")
             print(f"[{i:2d}] run  {dt*1e3:7.3f} ms  {len(item.ops):3d} ops "
                   f"ld={lk} st={sk} -> {dict(comp)}")
         elif isinstance(item, fusion.FrameSwap):
-            dt, amps = timeit(
-                lambda x: swap_bit_blocks(x, n=n, lo1=item.tile_bits - item.k,
-                                          lo2=item.tile_bits, k=item.k), amps)
+            with telemetry.span("runprof.item", index=i, kind="swap"):
+                dt, amps = timeit(
+                    lambda x: swap_bit_blocks(x, n=n,
+                                              lo1=item.tile_bits - item.k,
+                                              lo2=item.tile_bits, k=item.k),
+                    amps)
+            telemetry.set_gauge("runprof.item_ms", dt * 1e3, index=i,
+                                kind="swap")
             print(f"[{i:2d}] swap {dt*1e3:7.3f} ms")
         else:
             print(f"[{i:2d}] OTHER {type(item).__name__}")
             continue
         total += dt
     print(f"total {total*1e3:.1f} ms per circuit pass")
+    import json as _json
+    snap = telemetry.snapshot()
+    print("# telemetry counters:", _json.dumps(snap["counters"]))
+    print("# telemetry compile:", _json.dumps(
+        {k: v for k, v in snap["histograms"].items()
+         if k.startswith("mosaic_compile_seconds")}))
 
 
 if __name__ == "__main__":
