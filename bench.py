@@ -66,6 +66,11 @@ REF_GATES_PER_SEC = {20: 422.99, 24: 23.42, 26: 5.86, 28: 0.54}
 REF_DENSITY_CHANNEL_OPS_PER_SEC = {(14, "r3"): 0.93, (14, "r4"): 0.20}
 
 
+def _ring_depth() -> int:
+    from quest_tpu.ops.pallas_gates import ring_depth_default
+    return ring_depth_default()
+
+
 def build_circuit(n: int, depth: int):
     from quest_tpu.circuits import Circuit
     from __graft_entry__ import _random_layers
@@ -181,6 +186,11 @@ def _roofline(nsv: int, circuit_ms: float, passes: int) -> dict:
     telemetry.set_gauge("bench.per_pass_ms", per_pass, nsv=nsv)
     telemetry.set_gauge("bench.per_pass_vs_floor", per_pass / floor_ms,
                         nsv=nsv)
+    # per-signature pass histogram keyed by the active DMA ring depth, so
+    # a ring sweep (QUEST_PALLAS_RING=2..4 bench runs) accumulates a
+    # per-depth table in the artifact (ISSUE 2 tentpole)
+    telemetry.observe("pallas_per_pass_ms", per_pass, nsv=nsv,
+                      ring=_ring_depth())
     return {
         "stream_floor_ms": round(floor_ms, 3),
         "per_pass_ms": round(per_pass, 3),
@@ -398,6 +408,9 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
         "vs_baseline": round(gates_per_sec / ref, 3) if ref else None,
         "detail": {
             "chained_circuits": inner, "blocks_per_circuit": len(fused),
+            # the DMA ring operating point this run executed with
+            # (sweepable via QUEST_PALLAS_RING / Circuit.fused(ring_depth))
+            "ring_depth": _ring_depth(),
             # marginal (fixed-dispatch-free) device throughput + the
             # measured per-region fixed cost it excludes
             "device_gates_per_sec": round(device_rate, 1),
@@ -457,7 +470,11 @@ def _dist_comm_plan(circ) -> dict:
     """Deferred-permutation scheduler comm stats for the 34q circuit on an
     emulated 16-device mesh, vs the reference's immediate-swap-back policy
     (QuEST_cpu_distributed.c:1526-1568). Chunk units: 2 per pair exchange /
-    rank permute, 1 per relocation or reconciliation swap."""
+    rank permute, 1 per relocation or reconciliation swap, measured
+    grouped-permute units per relocation batch. The batched-vs-per-swap
+    relocation A/B (ISSUE 2 acceptance) ships in the stats: ``deferred``
+    is the production batched policy, ``deferred_per_swap_chunks`` the
+    same plan with batch_relocations=False."""
     from quest_tpu._compat import abstract_mesh
     from quest_tpu.environment import AMP_AXIS
     from quest_tpu.parallel.scheduler import comm_chunks, plan_circuit
@@ -466,9 +483,19 @@ def _dist_comm_plan(circ) -> dict:
     # 16-device mesh needs no hardware
     mesh = abstract_mesh((16,), (AMP_AXIS,))
     deferred = plan_circuit(circ, mesh)
+    per_swap = plan_circuit(circ, mesh, batch_relocations=False)
     immediate = plan_circuit(circ, mesh, defer=False)
     return {
         "deferred_chunks": comm_chunks(deferred),
+        "deferred_per_swap_chunks": comm_chunks(per_swap),
+        "relocation_batch_ab": {
+            "batched_chunks": deferred["relocation_batch_chunks"],
+            "swap_equiv_chunks":
+                deferred["relocation_batch_swap_equiv_chunks"],
+            "batches": deferred["relocation_batches"],
+            "batched_qubits": deferred["relocation_batch_qubits"],
+            "prefetched": deferred["relocation_prefetched"],
+        },
         "reference_policy_chunks": comm_chunks(immediate),
         "reduction_pct": round(100 * (1 - comm_chunks(deferred) /
                                       max(comm_chunks(immediate), 1)), 1),
@@ -520,9 +547,12 @@ def plan_17q_density_distributed() -> dict:
 
         mesh = abstract_mesh((ndev,), (AMP_AXIS,))
         deferred = plan_circuit(circ, mesh)
+        per_swap = plan_circuit(circ, mesh, batch_relocations=False)
         immediate = plan_circuit(circ, mesh, defer=False)
         detail["comm_plan_16dev"] = {
             "deferred_chunks": comm_chunks(deferred),
+            "deferred_per_swap_chunks": comm_chunks(per_swap),
+            "relocation_batches": deferred["relocation_batches"],
             "reference_policy_chunks": comm_chunks(immediate),
             "reduction_pct": round(100 * (1 - comm_chunks(deferred) /
                                           max(comm_chunks(immediate), 1)),
@@ -538,6 +568,47 @@ def plan_17q_density_distributed() -> dict:
         "unit": "kraus kernel ops",
         "vs_baseline": None,
         "detail": detail,
+    }
+
+
+def plan_20q_relocation_smoke() -> dict:
+    """CI-gate config (round 6): the sharded 20q plan's batched-relocation
+    stats on an abstract 8-device mesh, with the trace-time telemetry
+    chunk-units cross-checked against the plan_circuit comm model in the
+    artifact itself -- the bench-smoke workflow asserts
+    ``model_matches_telemetry`` and the A/B fields are present
+    (.github/workflows/native.yml). Pure jax.eval_shape: no devices, no
+    state allocation, runs in seconds on the CI box."""
+    from quest_tpu import telemetry
+    from quest_tpu._compat import abstract_mesh
+    from quest_tpu.environment import AMP_AXIS
+    from quest_tpu.parallel.scheduler import comm_chunks, plan_circuit
+
+    mesh = abstract_mesh((8,), (AMP_AXIS,))
+    circ = build_circuit(20, 4)
+    t0 = sum(telemetry.counters("comm_chunk_units_total").values())
+    batched = plan_circuit(circ, mesh)
+    t1 = sum(telemetry.counters("comm_chunk_units_total").values())
+    per_swap = plan_circuit(circ, mesh, batch_relocations=False)
+    model = comm_chunks(batched)
+    return {
+        "config": "plan_20q_relocation",
+        "metric": "20q sharded plan comm chunk-units, batched relocations "
+                  "(8-device model)",
+        "value": round(model, 4),
+        "unit": "chunk-units",
+        "vs_baseline": None,
+        "detail": {
+            "relocation_batches": batched["relocation_batches"],
+            "relocation_batch_qubits": batched["relocation_batch_qubits"],
+            "relocation_prefetched": batched["relocation_prefetched"],
+            "relocation_batch_chunks": batched["relocation_batch_chunks"],
+            "relocation_batch_swap_equiv_chunks":
+                batched["relocation_batch_swap_equiv_chunks"],
+            "per_swap_chunks": round(comm_chunks(per_swap), 4),
+            "telemetry_chunk_units": round(t1 - t0, 6),
+            "model_matches_telemetry": bool(abs((t1 - t0) - model) < 1e-6),
+        },
     }
 
 
@@ -633,13 +704,15 @@ def main() -> None:
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for CI (12 qubits, depth 2)")
     p.add_argument("--config",
-                   choices=["all", "statevec", "density", "f64",
-                            "20q", "24q", "26q"],
+                   choices=["all", "statevec", "density", "density_f64",
+                            "f64", "20q", "24q", "26q"],
                    default="all",
                    help="all: every BASELINE.json milestone config (default);"
                         " statevec: one random Clifford+T run at --qubits;"
                         " 20q/24q/26q: one statevec run at that size;"
                         " density: the 14q decoherence channel;"
+                        " density_f64: the same channel circuit at"
+                        " QUEST_PRECISION=2 (df kraus kernel bodies);"
                         " f64: the 20q statevec at QUEST_PRECISION=2"
                         " (double-float kernels)")
     p.add_argument("--emit", choices=["headline", "full"],
@@ -665,6 +738,27 @@ def main() -> None:
 
     if args.config == "density":
         r = bench_density(14 if not args.smoke else 6, args.reps, sync)
+        _emit(r, [r], args.emit)
+        return
+    if args.config == "density_f64":
+        # the df kraus kernel bodies (ops/pallas_df.py _ops_body_df kraus
+        # arm) were never benched before round 6 (VERDICT r5 ask #7); the
+        # reference anchors apply unchanged -- its qreal IS double
+        if os.environ.get("QUEST_PRECISION") != "2":
+            # precision is fixed at import; re-exec with the env set
+            r = _subprocess_config(
+                ["--config", "density_f64", "--reps", str(args.reps)]
+                + (["--smoke"] if args.smoke else []),
+                env={"QUEST_PRECISION": "2"}, budget_s=2400,
+                unit="ops/sec", slug="density14_f64",
+                metric="channel-ops/sec, 14-qubit density matrix "
+                       "(mixDepolarising+mixKrausMap, PRECISION=2 "
+                       "double-float)")
+            _emit(r, [r], args.emit)
+            return
+        r = bench_density(14 if not args.smoke else 6, args.reps, sync)
+        r["config"] = "density14_f64"
+        r["metric"] += " (PRECISION=2 double-float)"
         _emit(r, [r], args.emit)
         return
     if args.config == "f64":
@@ -699,7 +793,12 @@ def main() -> None:
         return
     if args.config == "statevec" or args.smoke:
         r = bench_statevec(args.qubits, args.depth, args.reps, sync)
-        _emit(r, [r], args.emit)
+        cfgs = [r]
+        if args.smoke:
+            # the CI bench-smoke gate asserts this config's relocation
+            # A/B fields and its telemetry-vs-model cross-check
+            cfgs.append(plan_20q_relocation_smoke())
+        _emit(r, cfgs, args.emit)
         return
 
     # all milestone configs (BASELINE.json "configs"); headline = 26q.
@@ -718,8 +817,15 @@ def main() -> None:
         slug="f64_20q",
         metric="gate-ops/sec, 20-qubit state-vector random Clifford+T "
                "(PRECISION=2 double-float)"))
+    configs.append(_subprocess_config(
+        ["--config", "density_f64", "--reps", str(args.reps)],
+        budget_s=2400, env={"QUEST_PRECISION": "2"}, unit="ops/sec",
+        slug="density14_f64",
+        metric="channel-ops/sec, 14-qubit density matrix "
+               "(mixDepolarising+mixKrausMap, PRECISION=2 double-float)"))
     configs.append(plan_34q_distributed())
     configs.append(plan_17q_density_distributed())
+    configs.append(plan_20q_relocation_smoke())
     # headline = the 26q statevec config, selected by metric string so list
     # reordering can never silently change what is reported
     headline = dict(next(c for c in configs

@@ -107,21 +107,49 @@ def _defer_safe(f) -> bool:
 
 def _tape_accesses(tape, num_qubits, is_density, dtype):
     """Per-entry logical-qubit access sets for the deferred scheduler's
-    Belady eviction (None = barrier). Dense/diag fused blocks expose their
-    qubits directly; raw gate entries are spy-captured; density row events
-    gain their conj-shadow column coordinates."""
+    Belady eviction (None = barrier), PLUS the aligned per-entry DENSE
+    subsets (qubits used in a relocation-forcing role) the round-6
+    relocation batcher prefetches from; returns ``(accesses, dense)``.
+    Dense membership mirrors the scheduler's own dispatch: non-diagonal
+    matrix targets and X-class targets relocate (apply_matrix / apply_x in
+    deferred mode) and channel rows AND columns relocate, while controls,
+    parity members, diagonal targets and uncontrolled SWAPs (virtual)
+    never do. Dense/diag fused blocks expose their qubits directly; raw
+    gate entries are spy-captured; density row events gain their
+    conj-shadow column coordinates."""
+    import numpy as np
+
     from . import fusion
 
+    def event_dense(ev):
+        """The event's relocation-forcing qubits (row coordinates)."""
+        if ev.kind == "x":
+            return set(ev.targets)
+        if ev.kind == "swap":
+            # uncontrolled SWAP is a pure layout update (virtual swap)
+            return set(ev.targets) if ev.controls else set()
+        if ev.kind == "channel":
+            return set(ev.targets)
+        if ev.kind == "matrix":
+            m = np.asarray(ev.matrix)
+            if np.any(m - np.diag(np.diag(m)) != 0):
+                return set(ev.targets)
+            return set()
+        return set()  # diag / parity / aux: comm-free under any layout
+
     out = []
+    dense_out = []
     for f, args, kwargs in tape:
         if not _defer_safe(f):
             out.append(None)
+            dense_out.append(None)
             continue
         if f is fusion._apply_dense_block:
             qs = set(args[1])
             if is_density:
                 qs |= {q + num_qubits for q in qs}
             out.append(frozenset(qs))
+            dense_out.append(frozenset(qs))
             continue
         if getattr(f, "__name__", "") == "_apply_gate_diag":
             # DiagBlock tape entries: (diag, qubits)
@@ -129,22 +157,29 @@ def _tape_accesses(tape, num_qubits, is_density, dtype):
             if is_density:
                 qs |= {q + num_qubits for q in qs}
             out.append(frozenset(qs))
+            dense_out.append(frozenset())
             continue
         events = fusion.capture(f, args, kwargs, num_qubits, dtype,
                                 is_density=is_density, aux=True)
         if events is None:
             out.append(None)
+            dense_out.append(None)
             continue
         qs = set()
+        ds = set()
         for ev in events:
             s = set(ev.support)
+            d = event_dense(ev)
             if is_density and (not ev.extended or ev.kind == "channel"):
                 # channel events carry ROW targets (extended only means "no
                 # shadow twin"); their column qubits are accessed too
                 s |= {q + num_qubits for q in s}
+                d |= {q + num_qubits for q in d}
             qs |= s
+            ds |= d
         out.append(frozenset(qs))
-    return out
+        dense_out.append(frozenset(ds))
+    return out, dense_out
 
 
 def _amps_mesh(amps):
@@ -238,7 +273,7 @@ class Circuit:
                     if not lookahead_cell:
                         lookahead_cell.append(_tape_accesses(
                             tape, num_qubits, is_density, shell.dtype))
-                    sched.set_lookahead(lookahead_cell[0])
+                    sched.set_lookahead(*lookahead_cell[0])
                 for i, (f, args, kwargs) in enumerate(tape):
                     if sched is not None and sched.deferring:
                         sched.advance(i)
@@ -291,7 +326,8 @@ class Circuit:
         return self._compiled[key]
 
     def fused(self, max_qubits: int = 5, dtype=None,
-              pallas: bool = False, shard_devices: int | None = None) -> "Circuit":
+              pallas: bool = False, shard_devices: int | None = None,
+              ring_depth: int | None = None) -> "Circuit":
         """A new Circuit with runs of gates contracted into ``max_qubits``-
         qubit unitaries at trace time (see :mod:`quest_tpu.fusion`).
 
@@ -309,6 +345,11 @@ class Circuit:
         shard_map (fusion._shard_map_pallas_run); Circuit.run keeps that
         per-shard path active inside the jitted replay by deriving the
         execution mesh from the register it is given (fusion.pallas_mesh).
+
+        ``ring_depth`` is the PLAN-level knob for the manual-DMA ring
+        (ops.pallas_gates._make_dma_kernel): stamped onto every emitted
+        PallasRun, it outranks the QUEST_PALLAS_RING env default when the
+        runs execute. None leaves the process default in charge.
         """
         import numpy as np
 
@@ -359,6 +400,10 @@ class Circuit:
                             max_qubits=max_qubits,
                             pallas_tile_bits=tile_bits,
                             is_density=self.is_density_matrix)
+        if ring_depth is not None:
+            for item in p.items:
+                if isinstance(item, fusion.PallasRun):
+                    item.ring_depth = int(ring_depth)
         out = Circuit(self.num_qubits, self.is_density_matrix)
         out._tape = fusion.as_tape(p)
         return out

@@ -430,6 +430,9 @@ class PallasRun:
     store_swap_k: int = 0
     load_swap_hi: int | None = None
     store_swap_hi: int | None = None
+    #: manual-DMA ring depth override for this run (None = the process
+    #: default: QUEST_PALLAS_RING env, else pallas_gates._DEF_RING_DEPTH)
+    ring_depth: int | None = None
 
 
 @dataclass
@@ -572,6 +575,7 @@ class _FramePlanner:
         self.tb = tile_bits
         self.k = k
         self.nsv = nsv
+        self.boundary = boundary  # shard-local qubit count (or None)
         #: candidate frames: identity + one per k-wide grid block. Block
         #: edges align to ``boundary`` (the shard-local qubit count) so
         #: frames stay entirely below it where possible -- their
@@ -626,21 +630,40 @@ class _FramePlanner:
         high targets, with kf kept small enough that the displaced
         sublane region avoids the op's low targets, restores coverage.
         The synthesized frame joins ``self.frames`` so later ops (and
-        the run scheduler) reuse it."""
+        the run scheduler) reuse it.
+
+        When a shard boundary is set and the minimal span block straddles
+        it, boundary-CLIPPED anchors are tried first (round 6, closing the
+        last round-5 ADVICE finding): a clipped block keeps its transposes
+        shard-local (or confines the collective to the genuinely sharded
+        bits), so a straddling frame -- whose reuse by later ops would pay
+        collective transposes they don't need -- is accepted only when no
+        clipped anchor localises the op."""
         targs = tuple(op.targets)
         high = sorted(t for t in targs if t >= self.tb)
         if not high or self.k <= 0:
             return None
         lo_t = [t for t in targs if t < self.tb]
+        max_lo = max(lo_t, default=-1)
         hi0 = high[0]
         kf = high[-1] + 1 - hi0
-        # the displaced region [tb-kf, tb) must stay above every low
-        # target, and the block must fit the frame width and register
-        max_lo = max(lo_t, default=-1)
-        if kf > self.k or kf >= self.tb - max_lo or hi0 + kf > self.nsv:
-            return None
-        f = (hi0, kf)
-        return f if self.feasible(op, f) else None
+        b = self.boundary
+        cands = []
+        if b is not None and hi0 < b < hi0 + kf:
+            # span block straddles the boundary: clipped anchors first
+            cands.append((hi0, b - hi0))
+            cands.append((b, high[-1] + 1 - b))
+        cands.append((hi0, kf))
+        for a0, w in cands:
+            # the displaced region [tb-w, tb) must stay above every low
+            # target, and the block must fit the frame width and register
+            if w <= 0 or w > self.k or w >= self.tb - max_lo \
+                    or a0 + w > self.nsv:
+                continue
+            f = (a0, w)
+            if self.feasible(op, f):
+                return f
+        return None
 
     def feasible_somewhere(self, op: _POp) -> bool:
         return (any(self.feasible(op, f) for f in self.frames)
@@ -1023,7 +1046,7 @@ def tape_transpose_stats(tape, shard_qubits: int | None,
     for f, a, _ in tape:
         name = getattr(f, "__name__", "")
         if name == "_apply_pallas_run":
-            ops, tb, lk, sk, lh, sh = a
+            ops, tb, lk, sk, lh, sh = a[:6]  # a[6] (ring depth, optional)
             p.items.append(PallasRun(tuple(ops), tb, load_swap_k=lk,
                                      store_swap_k=sk, load_swap_hi=lh,
                                      store_swap_hi=sh))
@@ -1190,7 +1213,8 @@ def active_pallas_mesh():
 def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                       load_swap_k: int = 0, store_swap_k: int = 0,
                       load_swap_hi: int | None = None,
-                      store_swap_hi: int | None = None) -> None:
+                      store_swap_hi: int | None = None,
+                      ring_depth: int | None = None) -> None:
     """Tape-entry wrapper for a PallasRun. Ops are RAW kernel ops over the
     full flattened state: density plans carry explicit conj-shadow twins
     (fusion._shadow_pop), so no path here re-derives shadows.
@@ -1326,7 +1350,8 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                     load_swap_hi=load_swap_hi if (foldable and ci == 0)
                     else None,
                     store_swap_hi=store_swap_hi if (foldable and ci == last)
-                    else None)
+                    else None,
+                    ring_depth=ring_depth)
             qureg.put(df_join(planes))
             if k_max and not foldable:
                 post_swap()
@@ -1353,7 +1378,8 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
         load_swap_k=load_swap_k if foldable else 0,
         store_swap_k=store_swap_k if foldable else 0,
         load_swap_hi=load_swap_hi if foldable else None,
-        store_swap_hi=store_swap_hi if foldable else None))
+        store_swap_hi=store_swap_hi if foldable else None,
+        ring_depth=ring_depth))
     if k_max and not foldable:
         post_swap()
 
@@ -1566,7 +1592,7 @@ def as_tape(p: FusePlan) -> list:
             entries.append((_apply_pallas_run,
                             (item.ops, item.tile_bits, item.load_swap_k,
                              item.store_swap_k, item.load_swap_hi,
-                             item.store_swap_hi), {}))
+                             item.store_swap_hi, item.ring_depth), {}))
         elif isinstance(item, FrameSwap):
             entries.append((_apply_frame_swap,
                             (item.tile_bits, item.k, item.hi), {}))

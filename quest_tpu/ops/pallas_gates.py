@@ -59,6 +59,7 @@ down.
 from __future__ import annotations
 
 import math
+import os
 import time
 from functools import partial
 
@@ -82,6 +83,42 @@ _LANES = 1 << LANE_BITS
 #: (measured OOM at 24 mixed ops). S=4096 also raises local_qubits by
 #: one over round 3 -- more in-tile targets per fused run.
 _DEF_SUBLANES = 1 << 12
+
+#: default in-flight DMA ring depth for the manual chunk pipeline
+#: (_make_dma_kernel). 2 = the classic double buffer; 3 adds one spare
+#: slot so a chunk whose bf16x3 zone dots finish before its store drains
+#: does not stall the sweep on the store-wait (the round-5 verdict's
+#: per-pass-stall finding). 3 is the widest depth whose ring buffers
+#: (2 * ring * 4 MiB at the S=4096 f32 tile) stay within _RING_VMEM_BUDGET
+#: alongside the op temporaries of the bench's longest fused runs -- the
+#: operating point committed from the tools/kernelprobe --ring sweep
+#: (re-sweep it on-chip when S or the op mix changes; BASELINE.md table).
+_DEF_RING_DEPTH = 3
+
+#: env override for the ring depth: sweepable without code edits
+#: (acceptance: ISSUE 2 tentpole). The fused_local_run ``ring_depth``
+#: argument -- the plan-level knob -- outranks it.
+_RING_ENV = "QUEST_PALLAS_RING"
+
+#: VMEM the ring's in+out tile buffers may claim. The Mosaic scoped-VMEM
+#: limit is raised to 100 MiB for these kernels; holding the ring to
+#: slightly under half keeps room for the per-op temporaries that made
+#: S=8192 double-buffers OOM at 24 mixed ops (round-4 probe). Depths that
+#: exceed it derate one slot at a time rather than failing to compile.
+_RING_VMEM_BUDGET = 48 * 1024 * 1024
+
+
+def ring_depth_default() -> int:
+    """The process-wide DMA ring depth: QUEST_PALLAS_RING if set (min 2),
+    else _DEF_RING_DEPTH."""
+    raw = os.environ.get(_RING_ENV, "").strip()
+    if raw:
+        try:
+            return max(2, int(raw))
+        except ValueError:
+            pass
+    return _DEF_RING_DEPTH
+
 
 #: matmul precision for the in-kernel zone dots (lane_u / window). Mosaic
 #: lowers only DEFAULT and HIGHEST (Precision.HIGH raises
@@ -730,14 +767,24 @@ def _make_kernel(ops, s_bits, tile_bits, dtype, local_n=None,
 
 
 def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
-                     nchunks: int, load_swap, store_swap, df=False):
-    """Manual double-buffered-DMA kernel: ONE pallas program owns the whole
-    pass, looping over the 2^grid chunks with explicit async copies --
-    next chunk's load and previous chunk's store overlap the current
-    chunk's compute. Measured vs the BlockSpec grid pipeline at 2^26 amps:
-    full-state copy 3.9 vs 6.3 ms (the BlockSpec pipeline leaves ~40% of
-    HBM bandwidth on the table; round-3 probe), which is most of the 26q
-    bench's per-pass floor.
+                     nchunks: int, load_swap, store_swap, df=False,
+                     ring: int = 2):
+    """Manual ring-buffered-DMA kernel: ONE pallas program owns the whole
+    pass, looping over the 2^grid chunks with explicit async copies through
+    an N-slot in-flight ring (``ring`` load buffers + ``ring`` store
+    buffers) -- up to ring-1 chunk loads stay in flight ahead of the chunk
+    being computed, and a store only blocks when its slot comes around
+    again ``ring`` chunks later. Measured vs the BlockSpec grid pipeline at
+    2^26 amps: full-state copy 3.9 vs 6.3 ms (the BlockSpec pipeline
+    leaves ~40% of HBM bandwidth on the table; round-3 probe), which is
+    most of the 26q bench's per-pass floor. Depth > 2 exists to hide the
+    round-5 finding that the two-slot ring serialises on its own
+    store-wait whenever a chunk's compute (the bf16x3 zone dots) runs
+    shorter than its store drains: with N slots the dots of chunks
+    c..c+N-2 overlap the still-draining stores of chunks c-N..c-1 instead
+    of stalling the sweep. Depth is a tunable (``ring_depth`` on
+    fused_local_run / QUEST_PALLAS_RING); VMEM cost is linear in depth
+    (2 * ring tile buffers), so the caller derates depth on op-heavy runs.
 
     ``load_swap``/``store_swap`` = (dk, s_low, gm_sz) fold the frame-swap
     relabeling into the chunk DMAs: the operand arrives as the 7-D
@@ -745,6 +792,7 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
     strided descriptor gathering/scattering the dk sub-blocks."""
 
     P = 4 if df else 2
+    ring = max(2, min(int(ring), nchunks))
 
     def kernel(x_hbm, *refs):
         w_refs = refs[:-1]
@@ -797,7 +845,10 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
                     outs.at[slot], o_hbm.at[:, hi2, :, gm, dnew],
                     wsem.at[slot])
 
-            load_dma(0, 0).start()
+            # prologue: fill all but one ring slot, so the steady-state
+            # loop always has ring-1 loads in flight ahead of the compute
+            for j in range(min(ring - 1, nchunks)):
+                load_dma(j, j).start()
 
             def gbit_for(c):
                 def gbit(q):
@@ -835,22 +886,28 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
                         outs[slot, i] = planes[i]
 
             def loop(c, carry):
-                # np.int32 literals: a bare `2` materialises as an i64
-                # constant under jax x64, and Mosaic's convert-lowering
+                # np.int32 literals: a bare python int materialises as an
+                # i64 constant under jax x64, and Mosaic's convert-lowering
                 # recurses infinitely narrowing it (round-5 find)
-                slot = c % np.int32(2)
-                nxt = (c + np.int32(1)) % np.int32(2)
+                ring_i = np.int32(ring)
+                slot = c % ring_i
+                ahead = c + np.int32(ring - 1)
+                nxt = ahead % ring_i
 
-                @pl.when(c + 1 < nchunks)
+                @pl.when(ahead < nchunks)
                 def _():
-                    load_dma(nxt, c + 1).start()
+                    # slot (c-1) % ring was freed when chunk c-1's compute
+                    # consumed it last iteration; refill it ring-1 ahead
+                    load_dma(nxt, ahead).start()
 
                 load_dma(slot, c).wait()
                 planes = compute(load_planes(slot), gbit_for(c))
 
-                @pl.when(c >= 2)
+                @pl.when(c >= ring_i)
                 def _():
-                    store_dma(slot, c - 2).wait()
+                    # the store that used this slot ring chunks ago must
+                    # drain before the slot's output buffer is overwritten
+                    store_dma(slot, c - ring_i).wait()
 
                 store_planes(slot, planes)
                 store_dma(slot, c).start()
@@ -869,8 +926,8 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
                 return c + np.int32(1)
 
             jax.lax.while_loop(w_cond, w_body, jnp.asarray(0, jnp.int32))
-            for c in range(max(0, nchunks - 2), nchunks):
-                store_dma(c % 2, c).wait()
+            for c in range(max(0, nchunks - ring), nchunks):
+                store_dma(c % ring, c).wait()
 
         if load_swap is not None:
             dk, s_low, _ = load_swap
@@ -884,10 +941,10 @@ def _make_dma_kernel(ops, s: int, tile_bits: int, dtype,
             out_shape = (P, s, _LANES)
         pl.run_scoped(
             body,
-            ins=pltpu.VMEM((2,) + in_shape, dtype),
-            outs=pltpu.VMEM((2,) + out_shape, dtype),
-            rsem=pltpu.SemaphoreType.DMA((2,)),
-            wsem=pltpu.SemaphoreType.DMA((2,)),
+            ins=pltpu.VMEM((ring,) + in_shape, dtype),
+            outs=pltpu.VMEM((ring,) + out_shape, dtype),
+            rsem=pltpu.SemaphoreType.DMA((ring,)),
+            wsem=pltpu.SemaphoreType.DMA((ring,)),
         )
 
     return kernel
@@ -897,7 +954,8 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
                     interpret: bool | None = None, shard_index=None,
                     load_swap_k: int = 0, store_swap_k: int = 0,
                     load_swap_hi: int | None = None,
-                    store_swap_hi: int | None = None):
+                    store_swap_hi: int | None = None,
+                    ring_depth: int | None = None):
     """Apply ``ops`` (see module doc) to the planar (2, 2^n) state in one
     fused Pallas pass. Every matrix target must satisfy
     ``q < local_qubits(n, sublanes)``; parity members and controls may be
@@ -919,7 +977,13 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
     an ARBITRARY grid-bit block into the top sublane slots -- the free
     generalisation of the reference's swap-to-local relocation
     (QuEST_cpu_distributed.c:1526-1568). Incompatible with
-    ``shard_index`` (the exchanged grid bits are sharded there)."""
+    ``shard_index`` (the exchanged grid bits are sharded there).
+
+    ``ring_depth`` sets the manual DMA pipeline's in-flight slot count
+    (None = the QUEST_PALLAS_RING env override, else _DEF_RING_DEPTH;
+    min 2); the chosen depth is clamped to the chunk count and derated to
+    fit _RING_VMEM_BUDGET, and the per-shard/BlockSpec grid paths ignore
+    it (the BlockSpec pipeline owns its own buffering)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if amps.shape[-1] < _LANES:
@@ -949,25 +1013,30 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
         shard_index = jnp.asarray(shard_index, jnp.int32).reshape(1)
         local_n = n
     ops_l = tuple(ops) if df else _fold_zone_ops(ops, lq)
+    ring = (max(2, int(ring_depth)) if ring_depth is not None
+            else ring_depth_default())
 
     def call():
         return _fused_local_run(
             amps, shard_index, n=n, ops=ops_l, sublanes=sublanes,
             interpret=bool(interpret), local_n=local_n,
             load_swap_k=int(load_swap_k), store_swap_k=int(store_swap_k),
-            load_swap_hi=load_swap_hi, store_swap_hi=store_swap_hi)
+            load_swap_hi=load_swap_hi, store_swap_hi=store_swap_hi,
+            ring_depth=ring)
 
     if not telemetry.enabled():
         return call()
     kind = "df" if df else str(np.dtype(amps.dtype))
     telemetry.inc("pallas_pass_total", kind="fused_run", dtype=kind)
+    # the requested operating point (pre clamp/derate -- the knob value)
+    telemetry.set_gauge("pallas_ring_depth", ring)
     # one read + one write of every plane is the pass's HBM traffic floor
     telemetry.inc("pallas_bytes_moved_total",
                   2 * amps.size * np.dtype(amps.dtype).itemsize,
                   kind="fused_run")
     sig = (n, ops_l, sublanes, int(load_swap_k), int(store_swap_k),
            load_swap_hi, store_swap_hi, local_n, str(amps.dtype),
-           amps.shape, bool(interpret))
+           amps.shape, bool(interpret), ring)
     if sig in _SEEN_KERNEL_SIGS:
         return call()
     # first dispatch of a new kernel signature: wall time here is Mosaic
@@ -982,7 +1051,8 @@ def fused_local_run(amps, *, n: int, ops: tuple, sublanes: int = _DEF_SUBLANES,
                     sublanes=min(sublanes, max(amps.shape[-1] >> LANE_BITS,
                                                1)),
                     load_swap_k=int(load_swap_k),
-                    store_swap_k=int(store_swap_k), seconds=round(dt, 4))
+                    store_swap_k=int(store_swap_k), ring=ring,
+                    seconds=round(dt, 4))
     return out
 
 
@@ -1026,13 +1096,15 @@ def _swap_spec(s: int, lo2_rel: int, k: int, planes: int = 2):
 
 @partial(jax.jit, static_argnames=("n", "ops", "sublanes", "interpret",
                                   "local_n", "load_swap_k", "store_swap_k",
-                                  "load_swap_hi", "store_swap_hi"),
+                                  "load_swap_hi", "store_swap_hi",
+                                  "ring_depth"),
          donate_argnums=(0,))
 def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
                      interpret: bool, local_n: int | None,
                      load_swap_k: int = 0, store_swap_k: int = 0,
                      load_swap_hi: int | None = None,
-                     store_swap_hi: int | None = None):
+                     store_swap_hi: int | None = None,
+                     ring_depth: int = _DEF_RING_DEPTH):
     num = amps.shape[-1]
     P = amps.shape[0]          # 2 planar planes, or 4 in df layout
     df = P == 4
@@ -1101,9 +1173,16 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
                                 store_swap_k).shape
         else:
             oshape = (P, grid, s, _LANES)
+        # ring depth: clamp to the chunk count, then derate until the ring
+        # buffers (in + out) fit the VMEM budget -- depth must never turn a
+        # compiling kernel into a Mosaic OOM
+        slot_bytes = P * s * _LANES * np.dtype(amps.dtype).itemsize
+        ring = max(2, min(int(ring_depth), grid))
+        while ring > 2 and 2 * ring * slot_bytes > _RING_VMEM_BUDGET:
+            ring -= 1
         kernel = _make_dma_kernel(tuple(ops_r), s, tile_bits,
                                   np.dtype(amps.dtype), grid, lsw, ssw,
-                                  df=df)
+                                  df=df, ring=ring)
         out = pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct(oshape, x.dtype),

@@ -60,17 +60,22 @@ def _ctrl_pred(r, shard_controls, shard_states, nl):
 
 
 def _apply_local_ctrl_mask(own, new, nl, local_controls, local_states):
-    """new where all local controls match, else own (grouped-view select)."""
+    """new where all local controls match, else own (flat-iota bit mask).
+
+    This was a grouped-view ``told.at[idx].set(new[idx])`` until round 6:
+    that scatter form MISCOMPILES when two shard_map kernels compose under
+    one jit on this container's jax (batched-relocation layouts surfaced
+    it: eager and single-kernel jit agree with the numpy oracle, two
+    chained kernels under jit corrupt exactly the control-masked half).
+    The elementwise select lowers to a fused where with identical traffic
+    and is immune to the scatter fusion."""
     if not local_controls:
         return new
-    shape, axis_of = grouped_axes(nl, tuple(local_controls))
-    gshape = (2,) + shape
-    idx = [slice(None)] * len(gshape)
+    j = lax.iota(jnp.int32, own.shape[1])
+    ok = jnp.ones(own.shape[1], bool)
     for c, s in zip(local_controls, local_states):
-        idx[axis_of[c] + 1] = s
-    idx = tuple(idx)
-    told = own.reshape(gshape)
-    return told.at[idx].set(new.reshape(gshape)[idx]).reshape(own.shape)
+        ok = jnp.logical_and(ok, ((j >> c) & 1) == s)
+    return jnp.where(ok[None, :], new, own)
 
 
 def _split_controls(controls, states, nl):

@@ -93,12 +93,19 @@ class DistributedScheduler:
     #: False reverts reconciliation to the round-3/4 per-cycle swap chain
     #: (for A/B plan stats; the collective path is the production one)
     collective_reconcile: bool = True
+    #: False reverts deferred-mode relocations to the round-5 one-swap-at-
+    #: a-time policy (for A/B plan stats; the batched grouped permute is
+    #: the production path -- see :meth:`_relocate`)
+    batch_relocations: bool = True
     stats: dict = field(default_factory=lambda: {
         "pair_exchanges": 0, "relocation_swaps": 0, "rank_permutes": 0,
         "comm_free": 0, "local": 0, "channel_superops": 0,
         "virtual_swaps": 0, "reconcile_swaps": 0,
         "reconcile_collectives": 0, "reconcile_chunks": 0.0,
         "reconcile_swap_equiv_chunks": 0.0,
+        "relocation_batches": 0, "relocation_batch_qubits": 0,
+        "relocation_prefetched": 0, "relocation_batch_chunks": 0.0,
+        "relocation_batch_swap_equiv_chunks": 0.0,
         "ici_chunks": 0.0, "dcn_chunks": 0.0})
 
     def _count_comm(self, n: int, qubit: int, chunks: float,
@@ -125,6 +132,7 @@ class DistributedScheduler:
         self._last_use = None   # logical qubit -> last-touch counter
         self._clock = 0
         self._future = None     # per-tape-entry access sets (Belady)
+        self._future_dense = None  # aligned relocation-forcing subsets
         self._cursor = 0
 
     def comm_volume(self, n: int, bytes_per_amp: int = 8) -> dict:
@@ -169,14 +177,24 @@ class DistributedScheduler:
         self.deferring = False
         self._pos = self._occ = self._last_use = None
         self._future = None
+        self._future_dense = None
         self._cursor = 0
 
-    def set_lookahead(self, accesses) -> None:
+    def set_lookahead(self, accesses, dense=None) -> None:
         """Future qubit-access sequence for Belady eviction: one entry per
         tape item -- a frozenset of the logical qubits it touches, or None
         for a barrier (layout reconciles there, so nothing beyond a barrier
-        matters for eviction). Circuit.as_fn installs this."""
+        matters for eviction). Circuit.as_fn installs this.
+
+        ``dense`` (aligned with ``accesses``) lists per entry the subset of
+        qubits used in a RELOCATION-FORCING role -- non-diagonal matrix /
+        X-class targets, channel rows+columns -- or None at barriers.
+        Only the relocation batcher reads it (:meth:`_pending_shard_uses`):
+        controls, parity members and diagonal targets are comm-free on
+        sharded qubits, so prefetching them would relocate (and evict) for
+        nothing. Without ``dense`` the batcher never prefetches."""
         self._future = list(accesses) if accesses is not None else None
+        self._future_dense = list(dense) if dense is not None else None
         self._cursor = 0
 
     def advance(self, index: int) -> None:
@@ -288,12 +306,70 @@ class DistributedScheduler:
         self._occ = list(range(n))
         return amps
 
+    def _pending_shard_uses(self, n, nl, exclude, capacity) -> list:
+        """Sharded physical positions that tape entries between the cursor
+        and the next reconciliation barrier will use in a RELOCATION-
+        FORCING role (dense targets -- see set_lookahead's ``dense``), in
+        first-use order (at most ``capacity``, skipping ``exclude``).
+        These are exactly the relocation swaps that would otherwise run
+        serially between two PallasRuns -- the batch candidates for
+        :meth:`_relocate`. Prefetching from the full access sets instead
+        measurably LOSES (34q plan probe): diagonal/control uses are
+        comm-free on sharded qubits, and relocating them evicts local
+        qubits into fresh relocations of their own."""
+        dense = getattr(self, "_future_dense", None)
+        if capacity <= 0 or dense is None or \
+                getattr(self, "_future", None) is None:
+            return []
+        self._ensure_perm(n)
+        out = []
+        seen = set(exclude)
+        for j in range(self._cursor, min(len(self._future), len(dense))):
+            if self._future[j] is None:
+                break  # reconciliation point: later uses are irrelevant
+            s = dense[j]
+            if not s:
+                continue
+            for lq in sorted(s):
+                p = self._pos[lq]
+                if p >= nl and p not in seen:
+                    seen.add(p)
+                    out.append((p, j))
+                    if len(out) >= capacity:
+                        return out
+        return out
+
+    def _next_dense_use(self, lq: int) -> int:
+        """Tape index of the next RELOCATION-FORCING access to logical
+        ``lq`` (a large sentinel if none before the next barrier) -- the
+        Belady counterpart of :meth:`_next_use` over the dense sets."""
+        dense = getattr(self, "_future_dense", None)
+        if dense is None:
+            return 1 << 30
+        for j in range(self._cursor, min(len(self._future), len(dense))):
+            if self._future[j] is None:
+                break
+            s = dense[j]
+            if s and lq in s:
+                return j
+        return 1 << 30
+
     def _relocate(self, amps, n, nl, phys_ts, support_phys,
                   on_fail: str = "raise"):
         """Swap each sharded physical position in ``phys_ts`` with a free
         local slot (deferred mode: LRU-occupant slot, no swap-back --
         callers read the new positions from the layout afterwards).
-        Returns (amps, {old_phys: new_phys})."""
+        Returns (amps, {old_phys: new_phys}).
+
+        Production path (round 6): in deferred mode the pending relocations
+        are BATCHED -- the positions this gate forces plus every sharded
+        position the lookahead sees used before the next barrier -- and,
+        when the batch beats the per-swap price, the whole batch runs as
+        ONE :func:`..exchange.dist_permute_bits` grouped all-to-all
+        (m crossings cost 2*(1-2^-m) < m; the reference pays one odd-parity
+        exchange per swap, QuEST_cpu_distributed.c:1443-1459). Singleton
+        batches keep the cheap pair-swap path (the costs tie at m=1), and
+        ``batch_relocations=False`` forces it for A/B plan stats."""
         shard = [p for p in phys_ts if p >= nl]
         if not shard:
             return amps, {}
@@ -319,6 +395,58 @@ class DistributedScheduler:
                 # no lookahead (eager deferral): least-recently-used,
                 # preferring high slots on ties (low qubits run hot)
                 free.sort(key=lambda p: (self._last_use[self._occ[p]], -p))
+        batch = list(shard)
+        slots = free[:len(shard)]
+        if self.deferring and self.batch_relocations:
+            # widen the batch with the relocations pending before the next
+            # barrier: the marginal crossing costs 2^-m of a chunk, far
+            # below the 1 unit each would pay as its own dist_swap later.
+            # Prefetch slots re-sort by the occupant's next DENSE use
+            # (farthest first: evicting a never-dense-used occupant is
+            # free), and admission is Belady-sound -- a candidate joins
+            # only if its first dense use comes BEFORE the next dense use
+            # of the occupant it evicts; past that point the prefetch
+            # trades one pending relocation for a fresh one (measured to
+            # LOSE on the 34q plan when admission was unconditional).
+            # Candidates arrive soonest-use-first and slots most-idle-
+            # first, so the first failed admission ends the matching.
+            tail = free[len(shard):]
+            tail.sort(key=lambda p: -self._next_dense_use(self._occ[p]))
+            for p, first_use in self._pending_shard_uses(
+                    n, nl, set(batch) | set(support_phys), len(tail)):
+                si = len(batch) - len(shard)
+                if si >= len(tail) or \
+                        first_use >= self._next_dense_use(
+                            self._occ[tail[si]]):
+                    break
+                batch.append(p)
+            slots = slots + tail[:len(batch) - len(shard)]
+        if self.deferring and self.batch_relocations and len(batch) >= 2:
+            pairs = list(zip(batch, slots))
+            swap_units = float(sum(_swap_price(f, s, nl)
+                                   for s, f in pairs))
+            source = list(range(n))
+            for s, f in pairs:
+                source[s], source[f] = source[f], source[s]
+            cstats = X.permute_collective_stats(n, tuple(source), self.mesh)
+            if cstats["chunk_units"] < swap_units:
+                self.stats["relocation_batches"] += 1
+                self.stats["relocation_batch_qubits"] += len(pairs)
+                self.stats["relocation_prefetched"] += len(batch) - len(shard)
+                self.stats["relocation_batch_chunks"] += \
+                    cstats["chunk_units"]
+                self.stats["relocation_batch_swap_equiv_chunks"] += \
+                    swap_units
+                # link attribution: the grouped all-to-all's volume split
+                # evenly over the crossing shard bits (as reconcile())
+                share = cstats["chunk_units"] / len(pairs)
+                for s, _ in pairs:
+                    self._count_comm(n, s, share, kind="relocation_batch")
+                amps = X.dist_permute_bits(amps, n=n, source=tuple(source),
+                                           mesh=self.mesh)
+                for s, f in pairs:
+                    self._swap_positions(f, s)
+                return amps, {s: f for s, f in pairs if s in set(shard)}
         relocation = {}
         for s, f in zip(shard, free):
             self.stats["relocation_swaps"] += 1
@@ -482,10 +610,13 @@ class DistributedScheduler:
 
 @contextmanager
 def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True,
-                  collective_reconcile: bool = True):
+                  collective_reconcile: bool = True,
+                  batch_relocations: bool = True):
     """Route L5 gate application through the explicit shard_map kernels.
     ``num_slices`` > 1 splits the plan's comm stats into ICI vs DCN chunks
-    (slice-major device order; parallel.mesh.shard_bit_link)."""
+    (slice-major device order; parallel.mesh.shard_bit_link).
+    ``batch_relocations=False`` forces the per-swap relocation policy
+    (A/B against the round-6 grouped-permute batching)."""
     from ..environment import AMP_AXIS
     if mesh is not None and mesh.size > 1 and AMP_AXIS not in mesh.shape:
         raise ValueError(
@@ -494,7 +625,8 @@ def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True,
             f"createQuESTEnv or Mesh(devices, ('{AMP_AXIS}',))")
     sched = (DistributedScheduler(mesh, num_slices=num_slices,
                                   allow_defer=defer,
-                                  collective_reconcile=collective_reconcile)
+                                  collective_reconcile=collective_reconcile,
+                                  batch_relocations=batch_relocations)
              if mesh is not None and mesh.size > 1 else None)
     prev = getattr(_STATE, "sched", None)
     _STATE.sched = sched
@@ -512,16 +644,19 @@ def active() -> DistributedScheduler | None:
 def comm_chunks(stats: dict) -> float:
     """Total comm traffic of a plan in chunk units, the single source of
     the cost-model weights (2 per pair exchange / rank permute, 1 per
-    relocation swap, 0 for virtual swaps, plus ``reconcile_chunks`` --
-    the measured units of whichever reconciliation policy ran, swap chain
-    or collective) -- comm_volume() and every report derive from this."""
+    relocation swap, 0 for virtual swaps, plus ``reconcile_chunks`` and
+    ``relocation_batch_chunks`` -- the measured units of whichever
+    reconciliation / relocation policy ran, per-swap or collective) --
+    comm_volume() and every report derive from this."""
     return (2.0 * stats["pair_exchanges"] + 1.0 * stats["relocation_swaps"]
             + 2.0 * stats["rank_permutes"]
-            + stats.get("reconcile_chunks", 0.0))
+            + stats.get("reconcile_chunks", 0.0)
+            + stats.get("relocation_batch_chunks", 0.0))
 
 
 def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
-                 defer: bool = True, collective_reconcile: bool = True):
+                 defer: bool = True, collective_reconcile: bool = True,
+                 batch_relocations: bool = True):
     """Trace ``circuit`` abstractly under the explicit scheduler and return
     its communication plan stats (no device execution -- jax.eval_shape)."""
     import jax
@@ -532,7 +667,8 @@ def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
     nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
     num_amps = 1 << nsv
     with explicit_mesh(mesh, num_slices=num_slices, defer=defer,
-                       collective_reconcile=collective_reconcile) as sched:
+                       collective_reconcile=collective_reconcile,
+                       batch_relocations=batch_relocations) as sched:
         fn = circuit.as_fn()
         jax.eval_shape(fn, jax.ShapeDtypeStruct((2, num_amps), real_dtype(None)))
     if sched is None:

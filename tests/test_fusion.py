@@ -206,3 +206,37 @@ def test_tape_transpose_stats_matches_plan_stats():
         st_tape = fusion.tape_transpose_stats(tape, n_local, **kwargs)
         assert st_plan == st_tape, (st_plan, st_tape)
     assert fusion.transpose_stats(p, n_local)["collective_transposes"] > 0
+
+
+def test_synth_frame_boundary_anchors():
+    """Round-6 (last open ADVICE r5 finding): _synth_frame respects the
+    shard boundary -- one-sided high targets get a block on their own side
+    (shard-local transpose), and a genuinely straddling target pair still
+    falls back to the spanning block (the clipped candidates cannot
+    localise both sides, so the collective frame is forced)."""
+    import numpy as np
+
+    from quest_tpu.fusion import FusePlan, _FramePlanner, _POp
+
+    # 17q-density-like geometry: tile 19 bits, frame width k=12, 34
+    # flattened qubits, shard boundary 30
+    pl = _FramePlanner(FusePlan(), 19, 12, 34, boundary=30)
+
+    # high target below the boundary: the synthesized block stays below it
+    op = _POp("kraus1", (16, 27), (), (), (), False)
+    f = pl._synth_frame(op)
+    assert f == (27, 1)
+    assert f[0] + f[1] <= 30
+    assert pl.feasible(op, f)
+
+    # high targets straddling the boundary: both clipped anchors miss one
+    # side, so the spanning (collective) frame is accepted as a fallback
+    op2 = _POp("kraus2", (10, 12, 29, 31), (), (), (), False)
+    f2 = pl._synth_frame(op2)
+    assert f2 == (29, 3)
+    assert pl.feasible(op2, f2)
+
+    # above-boundary one-sided targets anchor above it
+    op3 = _POp("kraus1", (10, 32), (), (), (), False)
+    f3 = pl._synth_frame(op3)
+    assert f3 == (32, 1) and f3[0] >= 30

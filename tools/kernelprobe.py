@@ -78,6 +78,29 @@ def main():
         amps, _ = timeit(run([("matrix", 0, (), (), T)], sublanes=s),
                          amps, f"floor S={s}")
 
+    # --- DMA ring depth x chunk size sweep (ISSUE 2 operating point) ----
+    # two signatures per point: the bare floor (DMA-bound) and a zone-dot
+    # mix (compute overlapping the sweep -- where depth > 2 earns its
+    # VMEM). Each observation lands in the pallas_per_pass_ms histogram so
+    # the committed BASELINE.md table regenerates from telemetry alone.
+    from quest_tpu import telemetry
+
+    W3r = HashableMatrix(np.stack([ru(128).real.T, ru(128).real.T,
+                                   ru(128).real.T]))
+    mixes = {"floor": [("matrix", 0, (), (), T)],
+             "dots": [("lane_u", W3r), ("matrix", 8, (), (), H),
+                      ("lane_u", W3r)]}
+    for s in (2048, 4096, 8192):
+        for ring in (2, 3, 4, 6):
+            for label, mix in mixes.items():
+                amps, best = timeit(
+                    run(mix, sublanes=s, ring_depth=ring), amps,
+                    f"ring={ring} S={s} {label}")
+                telemetry.observe("pallas_per_pass_ms", best * 1e3,
+                                  nsv=n, ring=ring, sublanes=s, mix=label)
+    print("# ring sweep histograms:",
+          telemetry.snapshot("pallas_per_pass_ms")["histograms"])
+
     # --- folded-swap DMA overheads (at the default S) -------------------
     # guard: a k-bit swap needs k grid bits above the tile (hi + k <= n)
     from quest_tpu.ops.pallas_gates import LANE_BITS
