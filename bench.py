@@ -466,7 +466,110 @@ def plan_34q_distributed() -> dict:
     }
 
 
-def _dist_comm_plan(circ) -> dict:
+def plan_20q_f64_smoke() -> dict:
+    """CI-gate config (round 7, ISSUE 3): the sharded 20q PRECISION=2 plan
+    on the double-float fast path, modeled on an abstract 8-device mesh --
+    the fused df tape's PallasRuns execute per shard under the explicit
+    scheduler and its frame transposes ride the COUNTED grouped permute on
+    the 4-plane state at the df 2x chunk-unit scale. The bench-smoke gate
+    asserts the config's presence, model == telemetry, the exact 2x df
+    accounting, and zero f64-engine fallbacks
+    (.github/workflows/native.yml). Pure jax.eval_shape; requires a
+    QUEST_PRECISION=2 + QUEST_PALLAS_DF=1 process (main() re-execs into
+    one)."""
+    import numpy as np
+
+    from quest_tpu import telemetry
+    from quest_tpu._compat import abstract_mesh
+    from quest_tpu.environment import AMP_AXIS
+    from quest_tpu.parallel.scheduler import comm_chunks, plan_circuit
+
+    mesh = abstract_mesh((8,), (AMP_AXIS,))
+    circ = build_circuit(20, 2)
+    fz = circ.fused(max_qubits=5, pallas=True, shard_devices=8,
+                    dtype=np.float64)
+
+    def counter_sum():
+        return sum(telemetry.counters("comm_chunk_units_total").values())
+
+    def fb():
+        return telemetry.counter_value("engine_fallback_total",
+                                       reason="f64_engine")
+
+    t0, f0 = counter_sum(), fb()
+    stats = plan_circuit(fz, mesh, dtype=np.float64)
+    t1, f1 = counter_sum(), fb()
+    model = comm_chunks(stats)
+    ft = stats["frame_transpose_chunks"]
+    ftp = stats["frame_transpose_planar_chunks"]
+    return {
+        "config": "plan_20q_f64",
+        "metric": "20q PRECISION=2 sharded df plan comm chunk-units "
+                  "(8-device model, frame transposes at the df 2x scale)",
+        "value": round(model, 4),
+        "unit": "chunk-units",
+        "vs_baseline": None,
+        "detail": {
+            "frame_transposes": stats["frame_transpose_collectives"],
+            "frame_transpose_chunks": ft,
+            "frame_transpose_planar_chunks": ftp,
+            "df_plane_scale": (ft / ftp) if ftp else None,
+            "relocation_batches": stats["relocation_batches"],
+            "relocation_batch_chunks": stats["relocation_batch_chunks"],
+            "telemetry_chunk_units": round(t1 - t0, 6),
+            "model_matches_telemetry": bool(abs((t1 - t0) - model) < 1e-6),
+            "engine_fallback_f64": f1 - f0,
+        },
+    }
+
+
+def plan_34q_f64() -> dict:
+    """The 34q flagship at PRECISION=2 (round 7, ISSUE 3): the
+    deferred-scheduler comm plan with the SAME relocation-batch A/B fields
+    as the f32 row (the exchange protocol is precision-agnostic in chunk
+    counts; bytes double via comm_volume(bytes_per_amp=16)), plus the
+    sharded DOUBLE-FLOAT pallas plan's shape -- the df tile
+    (ops/pallas_df.DF_SUBLANES -> 17-qubit tiles over the 30-qubit v5p-16
+    shards) re-planned for per-shard df execution, the path the round-6
+    policy routed to the ~170x-slower emulated-f64 engine. Requires a
+    QUEST_PRECISION=2 process (main() re-execs)."""
+    import numpy as np
+
+    from quest_tpu import fusion
+    from quest_tpu.ops.pallas_df import DF_SUBLANES
+    from quest_tpu.ops.pallas_gates import local_qubits
+
+    n, depth, ndev = 34, 8, 16
+    n_local = n - (ndev.bit_length() - 1)
+    circ = build_circuit(n, depth)
+    tile = local_qubits(n_local, DF_SUBLANES)
+    p = fusion.plan_pallas_sharded(tuple(circ._tape), n,
+                                   np.dtype(np.float64), 5, tile, n_local)
+    runs = [i for i in p.items if isinstance(i, fusion.PallasRun)]
+    detail = {
+        "gates": len(circ),
+        "df_tile_bits": tile,
+        "pallas_runs": len(runs),
+        "dense_blocks": sum(isinstance(i, fusion.FusedBlock)
+                            for i in p.items),
+        **fusion.transpose_stats(p, n_local),
+    }
+    try:
+        detail["comm_plan_16dev"] = _dist_comm_plan(circ, dtype=np.float64)
+    except Exception as e:  # the plan stats must not sink the artifact
+        detail["comm_plan_16dev"] = f"unavailable: {e}"
+    return {
+        "config": "plan_34q_f64",
+        "metric": "34q PRECISION=2 distributed plan: per-shard double-"
+                  "float PallasRuns for v5p-16 execution",
+        "value": len(p.items),
+        "unit": "blocks",
+        "vs_baseline": None,
+        "detail": detail,
+    }
+
+
+def _dist_comm_plan(circ, dtype=None) -> dict:
     """Deferred-permutation scheduler comm stats for the 34q circuit on an
     emulated 16-device mesh, vs the reference's immediate-swap-back policy
     (QuEST_cpu_distributed.c:1526-1568). Chunk units: 2 per pair exchange /
@@ -482,9 +585,9 @@ def _dist_comm_plan(circ) -> dict:
     # plan stats are trace-time only (jax.eval_shape): an abstract
     # 16-device mesh needs no hardware
     mesh = abstract_mesh((16,), (AMP_AXIS,))
-    deferred = plan_circuit(circ, mesh)
-    per_swap = plan_circuit(circ, mesh, batch_relocations=False)
-    immediate = plan_circuit(circ, mesh, defer=False)
+    deferred = plan_circuit(circ, mesh, dtype=dtype)
+    per_swap = plan_circuit(circ, mesh, batch_relocations=False, dtype=dtype)
+    immediate = plan_circuit(circ, mesh, defer=False, dtype=dtype)
     return {
         "deferred_chunks": comm_chunks(deferred),
         "deferred_per_swap_chunks": comm_chunks(per_swap),
@@ -705,7 +808,8 @@ def main() -> None:
                    help="tiny shapes for CI (12 qubits, depth 2)")
     p.add_argument("--config",
                    choices=["all", "statevec", "density", "density_f64",
-                            "f64", "20q", "24q", "26q"],
+                            "f64", "plan_f64", "plan_34q_f64",
+                            "20q", "24q", "26q"],
                    default="all",
                    help="all: every BASELINE.json milestone config (default);"
                         " statevec: one random Clifford+T run at --qubits;"
@@ -714,7 +818,11 @@ def main() -> None:
                         " density_f64: the same channel circuit at"
                         " QUEST_PRECISION=2 (df kraus kernel bodies);"
                         " f64: the 20q statevec at QUEST_PRECISION=2"
-                        " (double-float kernels)")
+                        " (double-float kernels);"
+                        " plan_f64: the sharded 20q PRECISION=2 df comm"
+                        " plan (CI smoke gate, df chunk-units at 2x);"
+                        " plan_34q_f64: the 34q PRECISION=2 sharded df"
+                        " plan + deferred comm A/B")
     p.add_argument("--emit", choices=["headline", "full"],
                    default="headline",
                    help="headline: compact <=1KB final line + "
@@ -786,6 +894,35 @@ def main() -> None:
         r["detail"]["vs_engine_f64"] = round(r["value"] / 866.0, 2)
         _emit(r, [r], args.emit)
         return
+    if args.config == "plan_f64":
+        if os.environ.get("QUEST_PRECISION") != "2":
+            # precision is fixed at import; re-exec with the env set (the
+            # df route needs QUEST_PALLAS_DF=1 off-TPU)
+            r = _subprocess_config(
+                ["--config", "plan_f64"],
+                env={"QUEST_PRECISION": "2", "QUEST_PALLAS_DF": "1"},
+                budget_s=1200, unit="chunk-units", slug="plan_20q_f64",
+                metric="20q PRECISION=2 sharded df plan comm chunk-units "
+                       "(8-device model, frame transposes at the df 2x "
+                       "scale)")
+            _emit(r, [r], args.emit)
+            return
+        r = plan_20q_f64_smoke()
+        _emit(r, [r], args.emit)
+        return
+    if args.config == "plan_34q_f64":
+        if os.environ.get("QUEST_PRECISION") != "2":
+            r = _subprocess_config(
+                ["--config", "plan_34q_f64"],
+                env={"QUEST_PRECISION": "2", "QUEST_PALLAS_DF": "1"},
+                budget_s=2400, unit="blocks", slug="plan_34q_f64",
+                metric="34q PRECISION=2 distributed plan: per-shard "
+                       "double-float PallasRuns for v5p-16 execution")
+            _emit(r, [r], args.emit)
+            return
+        r = plan_34q_f64()
+        _emit(r, [r], args.emit)
+        return
     if args.config in ("20q", "24q", "26q"):
         r = bench_statevec(int(args.config[:-1]), args.depth, args.reps,
                            sync)
@@ -798,6 +935,16 @@ def main() -> None:
             # the CI bench-smoke gate asserts this config's relocation
             # A/B fields and its telemetry-vs-model cross-check
             cfgs.append(plan_20q_relocation_smoke())
+            # ... and the sharded PRECISION=2 df plan's presence, 2x df
+            # chunk-unit accounting and zero f64-engine fallbacks
+            # (QUEST_PRECISION is fixed at import: budgeted subprocess)
+            cfgs.append(_subprocess_config(
+                ["--config", "plan_f64"],
+                env={"QUEST_PRECISION": "2", "QUEST_PALLAS_DF": "1"},
+                budget_s=1200, unit="chunk-units", slug="plan_20q_f64",
+                metric="20q PRECISION=2 sharded df plan comm chunk-units "
+                       "(8-device model, frame transposes at the df 2x "
+                       "scale)"))
         _emit(r, cfgs, args.emit)
         return
 
@@ -824,8 +971,20 @@ def main() -> None:
         metric="channel-ops/sec, 14-qubit density matrix "
                "(mixDepolarising+mixKrausMap, PRECISION=2 double-float)"))
     configs.append(plan_34q_distributed())
+    configs.append(_subprocess_config(
+        ["--config", "plan_34q_f64"], budget_s=2400,
+        env={"QUEST_PRECISION": "2", "QUEST_PALLAS_DF": "1"},
+        unit="blocks", slug="plan_34q_f64",
+        metric="34q PRECISION=2 distributed plan: per-shard double-float "
+               "PallasRuns for v5p-16 execution"))
     configs.append(plan_17q_density_distributed())
     configs.append(plan_20q_relocation_smoke())
+    configs.append(_subprocess_config(
+        ["--config", "plan_f64"], budget_s=1200,
+        env={"QUEST_PRECISION": "2", "QUEST_PALLAS_DF": "1"},
+        unit="chunk-units", slug="plan_20q_f64",
+        metric="20q PRECISION=2 sharded df plan comm chunk-units "
+               "(8-device model, frame transposes at the df 2x scale)"))
     # headline = the 26q statevec config, selected by metric string so list
     # reordering can never silently change what is reported
     headline = dict(next(c for c in configs

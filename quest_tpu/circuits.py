@@ -377,12 +377,16 @@ class Circuit:
             # below 2^LANE_BITS amplitudes there is no lane tile to build;
             # the ordinary fusion path handles such registers
             if n_eff > LANE_BITS:
+                from .ops.pallas_df import df_wanted
                 dt_plan = np.dtype(dtype) if dtype else real_dtype()
-                if dt_plan == np.dtype("float64") and \
-                        jax.default_backend() == "tpu":
-                    # f64 on TPU runs the double-float kernel, whose
-                    # tuned tile is smaller (ops/pallas_df.DF_SUBLANES);
-                    # CPU keeps the native-f64 interpreter geometry
+                if dt_plan == np.dtype("float64") and df_wanted():
+                    # f64 on the df route (TPU always; elsewhere opt-in
+                    # via QUEST_PALLAS_DF=1) runs the double-float
+                    # kernel, whose tuned tile is smaller
+                    # (ops/pallas_df.DF_SUBLANES) -- sharded plans built
+                    # here use the SAME geometry per shard, so the
+                    # local/dense split matches the df executor; the
+                    # native-f64 interpreter geometry applies otherwise
                     from .ops.pallas_df import DF_SUBLANES
                     tile_bits = local_qubits(n_eff, DF_SUBLANES)
                 else:

@@ -1210,6 +1210,21 @@ def active_pallas_mesh():
     return getattr(_PALLAS_MESH, "mesh", None)
 
 
+def _df_route(dtype) -> bool:
+    """True when an f64 register's PallasRuns take the double-float
+    (4-plane f32) kernel route: always on the TPU backend (Mosaic has no
+    f64 lowering, so df IS the f64 fast path there), opt-in elsewhere via
+    ``QUEST_PALLAS_DF=1`` (pallas_df.df_wanted) -- the switch the CPU-mesh
+    parity suite and the driver dryrun flip so CI executes the same route
+    as the chip. Off: non-TPU f64 keeps the native-f64 interpreter/engine
+    policy unchanged."""
+    import numpy as np
+
+    from .ops.pallas_df import df_wanted
+
+    return np.dtype(dtype) == np.dtype("float64") and df_wanted()
+
+
 def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                       load_swap_k: int = 0, store_swap_k: int = 0,
                       load_swap_hi: int | None = None,
@@ -1222,14 +1237,23 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
     Multi-device registers run the kernel PER SHARD under shard_map when
     every op is shard-executable (non-diagonal targets within the shard's
     tile; roles on sharded qubits resolve against the shard index inside
-    the kernel -- see fused_local_run's shard_index). Otherwise (explicit
-    scheduler active, non-canonical sharding, or a target the shard can't
-    pair) ops replay through the sharding-aware engine gate-by-gate.
+    the kernel -- see fused_local_run's shard_index). PRECISION=2
+    registers on the df route (fusion._df_route) run the double-float
+    4-plane kernels per shard, chunked at DF_MAX_OPS; under the explicit
+    distributed scheduler the per-shard df runs are joined by the
+    scheduler's COUNTED grouped permute collectives
+    (_sched_df_pallas_run). Otherwise (f32 under the explicit scheduler,
+    non-canonical sharding, or a target the shard can't pair) ops replay
+    through the sharding-aware engine gate-by-gate, with the reason
+    counted in engine_fallback_total.
 
     Frame swaps annotated on the run (load/store_swap_k) execute folded
-    into the kernel's DMA when the register is single-device and the tile
-    geometry matches the plan (zero extra passes); every other path gets
-    an explicit swap_bit_blocks pass before/after -- identical semantics.
+    into the kernel's DMA when the executing register's tile geometry
+    matches the plan -- single-device, or per-shard when the swapped
+    block is SHARD-LOCAL (round 7); every other case (collective
+    relabelings reaching sharded bits, geometry mismatches -- the latter
+    counted as swap_not_foldable) gets an explicit swap_bit_blocks pass
+    before/after -- identical semantics.
     """
     from .ops import pallas_gates as PG
     from .ops.pallas_gates import fused_local_run, swap_bit_blocks
@@ -1256,41 +1280,61 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                 k=store_swap_k))
 
     amps = qureg.amps
+    sched = _dist.active()
+
+    # --- explicit distributed scheduler x double-float register: the
+    # per-shard df fast path, frame relabelings riding the scheduler's
+    # counted grouped collectives (ISSUE 3 tentpole) ---
+    if (sched is not None and sched.mesh is not None
+            and sched.mesh.size > 1 and _df_route(qureg.dtype)):
+        if _sched_df_pallas_run(qureg, ops, sched, tile_bits, load_swap_k,
+                                store_swap_k, load_swap_hi, store_swap_hi,
+                                ring_depth):
+            return
+        # not shard-executable at the df tile geometry (reason counted
+        # inside): sharding-aware engine replay, explicit swap passes
+        pre_swap()
+        _apply_ops_via_engine(qureg, ops)
+        post_swap()
+        return
+
     mesh = active_pallas_mesh()
-    if (mesh is not None and mesh.size > 1 and _dist.active() is None
+    if (mesh is not None and mesh.size > 1 and sched is None
             and isinstance(amps, jax.core.Tracer)):
         # inside a jit trace the tracer hides its sharding; use the ambient
         # mesh, which Circuit.run derived from the register actually being
         # replayed (so it always matches the traced input's sharding)
-        pre_swap()
-        new = _run_pallas_sharded(qureg, ops, mesh)
-        if new is not None:
-            qureg.put(new)
-            post_swap()
+        if _dispatch_pallas_sharded(qureg, ops, mesh, tile_bits,
+                                    load_swap_k, store_swap_k,
+                                    load_swap_hi, store_swap_hi,
+                                    ring_depth, pre_swap, post_swap):
             return
-        telemetry.inc("engine_fallback_total", reason="shard_map_unsupported")
         if load_swap_k:  # swap already applied; replay ops via the engine
             _apply_ops_via_engine(qureg, ops)
             post_swap()
             return
     sharding = getattr(qureg.amps, "sharding", None)
     if sharding is not None and len(sharding.device_set) > 1:
-        pre_swap()
-        if _dist.active() is None:
-            new = _shard_map_pallas_run(qureg, ops)
-            if new is not None:
-                qureg.put(new)
-                post_swap()
-                return
-            telemetry.inc("engine_fallback_total",
-                          reason="shard_map_unsupported")
+        if sched is None:
+            mesh2 = _canonical_amps_mesh(qureg)
+            if mesh2 is not None:
+                if _dispatch_pallas_sharded(qureg, ops, mesh2, tile_bits,
+                                            load_swap_k, store_swap_k,
+                                            load_swap_hi, store_swap_hi,
+                                            ring_depth, pre_swap, post_swap):
+                    return
+            else:
+                telemetry.inc("engine_fallback_total",
+                              reason="shard_map_unsupported")
+                pre_swap()
         else:
             telemetry.inc("engine_fallback_total",
                           reason="explicit_scheduler")
+            pre_swap()
         _apply_ops_via_engine(qureg, ops)
         post_swap()
         return
-    if not _mosaic_supports(qureg.dtype):
+    if _df_route(qureg.dtype) or not _mosaic_supports(qureg.dtype):
         if ((mesh is None or mesh.size == 1)
                 and np.dtype(qureg.dtype) == np.dtype("float64")
                 and (1 << nsv) >= 2 * PG._LANES):
@@ -1356,8 +1400,10 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
             if k_max and not foldable:
                 post_swap()
             return
-        # sharded f64 (or sub-tile registers): XLA engine replay (with
-        # explicit frame-swap passes) remains the documented policy
+        # the genuinely unsupported f64 residue -- sub-tile registers, or
+        # sharded dispatch that already failed above -- keeps the counted
+        # engine fallback (sharded-df-CAPABLE runs no longer land here:
+        # they ride _dispatch_pallas_sharded / _sched_df_pallas_run)
         telemetry.inc("engine_fallback_total", reason="f64_engine")
         pre_swap()
         _apply_ops_via_engine(qureg, ops)
@@ -1384,25 +1430,25 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
         post_swap()
 
 
-def _shard_map_pallas_run(qureg, ops: tuple):
-    """Eager-path entry: run a PallasRun per-shard over the mesh of the
-    register's own (concrete) sharding, or None if the layout or the run
-    isn't shard-executable."""
+def _canonical_amps_mesh(qureg):
+    """The 1-D amps mesh of the register's concrete canonical sharding
+    (NamedSharding over P(None, AMP_AXIS)), or None."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from .environment import AMP_AXIS
 
-    sharding = qureg.amps.sharding
+    sharding = getattr(qureg.amps, "sharding", None)
     if not isinstance(sharding, NamedSharding):
         return None
     if sharding.spec != P(None, AMP_AXIS):
         return None
-    return _run_pallas_sharded(qureg, ops, sharding.mesh)
+    return sharding.mesh
 
 
-def _run_pallas_sharded(qureg, ops: tuple, mesh):
-    """shard_map the fused kernel over ``mesh`` if every op is executable
-    against the shard-local tile; None otherwise.
+def _sharded_run_plan(qureg, ops: tuple, mesh):
+    """Per-shard executability check: ((df, n_local, sublanes), None) when
+    every op of the run is executable against the shard-local tile, else
+    (None, fallback_reason).
 
     Legality: amplitude sharding splits off the TOP qubits, so each shard
     is a contiguous (2, 2^n_local) sub-state on which in-tile targets pair
@@ -1410,40 +1456,230 @@ def _run_pallas_sharded(qureg, ops: tuple, mesh):
     only on the shard index (jax.lax.axis_index -> the kernel's SMEM
     scalar). One HBM pass per device, zero communication -- the fusion
     analogue of the reference running its local kernel per rank between
-    exchanges (QuEST_cpu_distributed.c:870-905)."""
+    exchanges (QuEST_cpu_distributed.c:870-905). PRECISION=2 registers on
+    the df route check against the DF tile geometry (DF_SUBLANES), and a
+    plan built with non-DF geometry is the SHARDED df_tile_mismatch case
+    -- counted by the caller, never a runtime ValueError (the round-7
+    generalisation of the single-device guard)."""
+    from .environment import AMP_AXIS
+    from .ops import pallas_gates as PG
+
+    df = _df_route(qureg.dtype)
+    if tuple(mesh.shape.keys()) != (AMP_AXIS,):
+        return None, ("f64_engine" if df else "shard_map_unsupported")
+    ndev = mesh.shape[AMP_AXIS]
+    if ndev & (ndev - 1):
+        return None, ("f64_engine" if df else "shard_map_unsupported")
+    nsv = qureg.num_qubits_in_state_vec
+    n_local = nsv - (ndev.bit_length() - 1)
+    if df:
+        # one lane tile per shard suffices for the gridless df kernel
+        if (1 << n_local) < PG._LANES:
+            return None, "f64_engine"
+        from .ops.pallas_df import DF_SUBLANES
+        sublanes = DF_SUBLANES
+    else:
+        if not _mosaic_supports(qureg.dtype):
+            return None, "f64_engine"
+        if (1 << n_local) < 2 * PG._LANES:
+            return None, "shard_map_unsupported"
+        sublanes = PG._DEF_SUBLANES
+    lq = PG.local_qubits(n_local, sublanes)
+    for op in ops:
+        if any(q >= lq for q in PG.op_dense_targets(op)):
+            return None, ("df_tile_mismatch" if df
+                          else "shard_map_unsupported")
+    return (df, n_local, sublanes), None
+
+
+def _df_shard_chunks(ops: tuple, n_local: int, sublanes: int,
+                     lk: int = 0, sk: int = 0, lh=None, sh=None,
+                     ring_depth=None):
+    """Per-shard double-float executor factory: returns
+    ``run(planes, shard_idx) -> planes`` applying the op run to one
+    shard's (4, C) df planes, chunked at DF_MAX_OPS (Mosaic compile time
+    is superlinear in op count and df ops carry ~15x the arithmetic);
+    folded frame swaps ride the first/last chunk's DMA."""
+    from .ops import pallas_gates as PG
+    from .ops.pallas_df import DF_MAX_OPS
+
+    chunks = ([ops[i:i + DF_MAX_OPS]
+               for i in range(0, len(ops), DF_MAX_OPS)] or [tuple(ops)])
+    if len(chunks) > 1:
+        # each extra chunk is one extra HBM pass the plan did not price
+        # in -- visible, not silent (ISSUE 1 tentpole)
+        telemetry.inc("engine_fallback_total", len(chunks) - 1,
+                      reason="df_max_ops_split")
+    last = len(chunks) - 1
+
+    def run(planes, shard_idx):
+        for ci, chunk in enumerate(chunks):
+            planes = PG.fused_local_run(
+                planes, n=n_local, ops=chunk, sublanes=sublanes,
+                shard_index=shard_idx,
+                load_swap_k=lk if ci == 0 else 0,
+                load_swap_hi=lh if ci == 0 else None,
+                store_swap_k=sk if ci == last else 0,
+                store_swap_hi=sh if ci == last else None,
+                ring_depth=ring_depth)
+        return planes
+
+    return run
+
+
+def _exec_pallas_sharded(amps, mesh, ops: tuple, df: bool, n_local: int,
+                         sublanes: int, lk: int = 0, sk: int = 0,
+                         lh=None, sh=None, ring_depth=None):
+    """shard_map the fused kernel over ``mesh`` (caller has established
+    legality via _sharded_run_plan). f64-df shards split to the 4-plane
+    layout, run the df kernels (DF_MAX_OPS-chunked), and join back --
+    split/join are exact and shard-local. Folded frame swaps (lk/sk,
+    SHARD-LOCAL blocks only) ride the kernel DMA."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     from ._compat import shard_map
-
     from .environment import AMP_AXIS
     from .ops import pallas_gates as PG
 
-    if tuple(mesh.shape.keys()) != (AMP_AXIS,):
-        return None
-    if not _mosaic_supports(qureg.dtype):
-        return None
-    ndev = mesh.shape[AMP_AXIS]
-    if ndev & (ndev - 1):
-        return None
-    nsv = qureg.num_qubits_in_state_vec
-    n_local = nsv - (ndev.bit_length() - 1)
-    if (1 << n_local) < 2 * PG._LANES:
-        return None
-    lq = PG.local_qubits(n_local)
-    for op in ops:
-        if any(q >= lq for q in PG.op_dense_targets(op)):
-            return None
+    if df:
+        from .ops.pallas_df import df_join, df_split
 
-    def body(x):
-        hi = jax.lax.axis_index(AMP_AXIS)
-        return PG.fused_local_run(x, n=n_local, ops=ops, shard_index=hi)
+        run = _df_shard_chunks(ops, n_local, sublanes, lk, sk, lh, sh,
+                               ring_depth)
+
+        def body(x):
+            return df_join(run(df_split(x), jax.lax.axis_index(AMP_AXIS)))
+    else:
+        def body(x):
+            hi = jax.lax.axis_index(AMP_AXIS)
+            return PG.fused_local_run(
+                x, n=n_local, ops=ops, sublanes=sublanes, shard_index=hi,
+                load_swap_k=lk, load_swap_hi=lh, store_swap_k=sk,
+                store_swap_hi=sh, ring_depth=ring_depth)
 
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation, which the checker (on by default) rejects
     fn = shard_map(body, mesh=mesh, in_specs=P(None, AMP_AXIS),
                    out_specs=P(None, AMP_AXIS), check_vma=False)
-    return fn(qureg.amps)
+    return fn(amps)
+
+
+def _dispatch_pallas_sharded(qureg, ops: tuple, mesh, tile_bits: int,
+                             lk: int, sk: int, lh, sh, ring_depth,
+                             pre_swap, post_swap) -> bool:
+    """Route one PallasRun per shard over ``mesh`` (f32 native; f64 via
+    the double-float planes when the df route is on), folding SHARD-LOCAL
+    frame swaps into the per-shard kernel DMA and running the rest --
+    collective relabelings reaching sharded bits (the designed all-to-all
+    path), or shard-local swaps whose tile geometry mismatches the plan
+    (counted swap_not_foldable) -- as explicit transpose passes.
+
+    Returns True when handled end to end. Returns False with the fallback
+    reason counted and the load swap already applied explicitly (a no-op
+    when lk == 0), so the caller can replay the ops via the engine."""
+    from .ops import pallas_gates as PG
+
+    plan, reason = _sharded_run_plan(qureg, ops, mesh)
+    if plan is None:
+        telemetry.inc("engine_fallback_total", reason=reason)
+        pre_swap()
+        return False
+    df, n_local, sublanes = plan
+
+    def foldable(k, hi):
+        if not k:
+            return False
+        hi_eff = tile_bits if hi is None else hi
+        if hi_eff + k > n_local:
+            return False  # reaches sharded bits: the collective transpose
+        ok = (tile_bits == PG.local_qubits(n_local, sublanes)
+              and tile_bits - PG.LANE_BITS - k >= 3)
+        if not ok:
+            telemetry.inc("engine_fallback_total",
+                          reason="swap_not_foldable")
+        return ok
+
+    fold_l = foldable(lk, lh)
+    fold_s = foldable(sk, sh)
+    if lk and not fold_l:
+        pre_swap()
+    new = _exec_pallas_sharded(
+        qureg.amps, mesh, ops, df, n_local, sublanes,
+        lk=lk if fold_l else 0, lh=lh if fold_l else None,
+        sk=sk if fold_s else 0, sh=sh if fold_s else None,
+        ring_depth=ring_depth)
+    qureg.put(new)
+    if sk and not fold_s:
+        post_swap()
+    return True
+
+
+def _sched_df_pallas_run(qureg, ops: tuple, sched, tile_bits: int,
+                         lk: int, sk: int, lh, sh, ring_depth) -> bool:
+    """Explicit-scheduler route for a PallasRun on a sharded PRECISION=2
+    register (the ISSUE 3 tentpole): df-split ONCE, run the fused df
+    kernels per shard over the scheduler's mesh, and execute the run's
+    frame relabelings through the scheduler's COUNTED grouped permute
+    collective ON the 4-plane state (exchange.dist_permute_bits carries
+    all four planes natively; chunk-units price at the df 2x scale --
+    scheduler.DistributedScheduler.apply_frame_permute). Returns False
+    with the fallback reason counted when the run is not shard-executable
+    at the df tile geometry."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map
+    from .environment import AMP_AXIS
+    from .ops.pallas_df import df_join, df_split
+
+    plan, reason = _sharded_run_plan(qureg, ops, sched.mesh)
+    if plan is None:
+        telemetry.inc("engine_fallback_total", reason=reason)
+        return False
+    df, n_local, sublanes = plan
+    nsv = qureg.num_qubits_in_state_vec
+    planes = df_split(qureg.amps)
+    if lk:
+        telemetry.inc("pallas_pass_total", kind="frame_swap")
+        planes = sched.apply_frame_permute(
+            planes, n=nsv, lo1=tile_bits - lk,
+            lo2=tile_bits if lh is None else lh, k=lk)
+    run = _df_shard_chunks(ops, n_local, sublanes, ring_depth=ring_depth)
+
+    def body(x):
+        return run(x, jax.lax.axis_index(AMP_AXIS))
+
+    planes = shard_map(body, mesh=sched.mesh, in_specs=P(None, AMP_AXIS),
+                       out_specs=P(None, AMP_AXIS), check_vma=False)(planes)
+    if sk:
+        telemetry.inc("pallas_pass_total", kind="frame_swap")
+        planes = sched.apply_frame_permute(
+            planes, n=nsv, lo1=tile_bits - sk,
+            lo2=tile_bits if sh is None else sh, k=sk)
+    qureg.put(df_join(planes))
+    return True
+
+
+def _shard_map_pallas_run(qureg, ops: tuple):
+    """Eager-path entry: run a PallasRun per-shard over the mesh of the
+    register's own (concrete) sharding, or None if the layout or the run
+    isn't shard-executable."""
+    mesh = _canonical_amps_mesh(qureg)
+    if mesh is None:
+        return None
+    return _run_pallas_sharded(qureg, ops, mesh)
+
+
+def _run_pallas_sharded(qureg, ops: tuple, mesh):
+    """shard_map the fused kernel over ``mesh`` if every op is executable
+    against the shard-local tile; None otherwise (see _sharded_run_plan
+    for the legality rules and _exec_pallas_sharded for execution)."""
+    plan, _reason = _sharded_run_plan(qureg, ops, mesh)
+    if plan is None:
+        return None
+    df, n_local, sublanes = plan
+    return _exec_pallas_sharded(qureg.amps, mesh, ops, df, n_local, sublanes)
 
 
 def _apply_ops_via_engine(qureg, ops: tuple) -> None:
@@ -1569,12 +1805,22 @@ def _apply_frame_swap(qureg, tile_bits: int, k: int,
     """Tape-entry wrapper for FrameSwap: one relabeling transpose. Works on
     every backend (plain XLA); on a sharded register GSPMD lowers it to the
     all-to-all the relabeling implies (shard-local when [hi, hi+k) avoids
-    the sharded qubits)."""
+    the sharded qubits). Under an active explicit scheduler the transpose
+    rides the scheduler's COUNTED grouped permute instead
+    (apply_frame_permute), so the plan_circuit comm model and the
+    frame_transpose telemetry series stay exact."""
     from .ops.pallas_gates import swap_bit_blocks
+    from .parallel import scheduler as _dist
 
     telemetry.inc("pallas_pass_total", kind="frame_swap")
-    qureg.put(swap_bit_blocks(qureg.amps, n=qureg.num_qubits_in_state_vec,
-                              lo1=tile_bits - k,
+    nsv = qureg.num_qubits_in_state_vec
+    sched = _dist.active()
+    if sched is not None and sched.mesh is not None and sched.mesh.size > 1:
+        qureg.put(sched.apply_frame_permute(
+            qureg.amps, n=nsv, lo1=tile_bits - k,
+            lo2=tile_bits if hi is None else hi, k=k))
+        return
+    qureg.put(swap_bit_blocks(qureg.amps, n=nsv, lo1=tile_bits - k,
                               lo2=tile_bits if hi is None else hi, k=k))
 
 

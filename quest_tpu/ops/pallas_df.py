@@ -42,12 +42,48 @@ API-visible (2, 2^n) f64 planar state (both conversions are exact).
 from __future__ import annotations
 
 import math
+import os
 
 import jax.numpy as jnp
 import numpy as np
 
 #: Dekker split constant for f32 (24-bit mantissa): 2^12 + 1
 _SPLIT = np.float32(4097.0)
+
+#: number of f32 planes in the df state layout [re_hi, im_hi, re_lo, im_lo]
+DF_PLANES = 4
+
+#: env switch for the df ROUTE off-TPU (see :func:`df_wanted`)
+_DF_ENV = "QUEST_PALLAS_DF"
+
+#: env switch for the accurate (double-TwoSum) df addition
+_ACC_ENV = "QUEST_DF_ACCURATE_ADD"
+
+
+def df_wanted() -> bool:
+    """True when f64 registers should plan/execute on the double-float
+    fast path: always on the TPU backend (Mosaic has no f64 lowering, so
+    df IS the fast path there), opt-in elsewhere via ``QUEST_PALLAS_DF=1``
+    -- the switch the CPU-mesh parity suite and the driver dryrun flip so
+    the sharded df route executes in CI exactly as it does on-chip.
+    Off-TPU default stays the native-f64 interpreter/engine routing."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return True
+    return os.environ.get(_DF_ENV, "").strip() == "1"
+
+
+def accurate_add_enabled() -> bool:
+    """True when ``QUEST_DF_ACCURATE_ADD=1``: df additions use the
+    accurate double-TwoSum variant (uniform ~2^-47 relative bound, ~1.4x
+    the add cost) instead of the sloppy one-TwoSum form whose relative
+    error is unbounded under near-cancellation of the hi components (the
+    Dekker caveat flagged in ADVICE round 5; the reference guards its own
+    accumulations with Kahan summation, QuEST_cpu_distributed.c:62-78).
+    The flag enters every df kernel signature, so flipping it retraces
+    rather than replaying a stale cached kernel."""
+    return os.environ.get(_ACC_ENV, "").strip() == "1"
 
 #: longest op run per df kernel: Mosaic compile time is superlinear in op
 #: count and each df op lowers to ~15x the f32 arithmetic (a 27-op df
@@ -102,8 +138,26 @@ def df_add(x, y):
     return _quick2(s, e + (x[1] + y[1]))
 
 
+def df_add_accurate(x, y):
+    """Accurate double-double addition (a second TwoSum for the lo sum):
+    uniform ~2^-47 relative bound even when the hi components nearly
+    cancel -- the case where :func:`df_add`'s single rounding of
+    ``x.lo + y.lo`` dominates the (small) result. ~1.4x the cost; opt in
+    via ``QUEST_DF_ACCURATE_ADD=1`` (:func:`accurate_add_enabled`)."""
+    s, e = _two_sum(x[0], y[0])
+    t, f = _two_sum(x[1], y[1])
+    e = e + t
+    s, e = _quick2(s, e)
+    e = e + f
+    return _quick2(s, e)
+
+
 def df_sub(x, y):
     return df_add(x, (-y[0], -y[1]))
+
+
+def df_sub_accurate(x, y):
+    return df_add_accurate(x, (-y[0], -y[1]))
 
 
 def df_mul(x, y):
@@ -160,14 +214,52 @@ def df_join(planes):
 
 
 # ---------------------------------------------------------------------------
+# reductions over the df layout
+# ---------------------------------------------------------------------------
+
+def df_total_prob(planes, accurate: bool | None = None):
+    """sum |amp|^2 over a (4, N) df state, accumulated IN df arithmetic:
+    per-amplitude squares via exact Dekker products, then an adjacent-pair
+    cascade of df additions (shard-local on block-sharded inputs, like
+    ops.reduce._pairwise_sum). This is the df mirror of the reference's
+    Kahan-protected statevec_calcTotalProb (QuEST_cpu_distributed.c:62-119)
+    -- the norm/trace reduction the accurate-add option exists for:
+    ``accurate=None`` follows ``QUEST_DF_ACCURATE_ADD``
+    (:func:`accurate_add_enabled`), and the near-cancellation-free bound of
+    the accurate add keeps the accumulated norm within ~2^-47 of the numpy
+    f64 oracle (tested in tests/test_sharded_df.py). Returns a scalar
+    (f64 when jax x64 is on, else the joined f32 sum)."""
+    add = df_add_accurate if (accurate if accurate is not None
+                              else accurate_add_enabled()) else df_add
+    re = (planes[0], planes[2])
+    im = (planes[1], planes[3])
+    acc = add(df_mul(re, re), df_mul(im, im))  # per-amplitude |amp|^2
+    hi, lo = acc
+    while hi.shape[-1] > 1:
+        if hi.shape[-1] % 2:
+            break
+        h2 = hi.reshape(-1, 2)
+        l2 = lo.reshape(-1, 2)
+        hi, lo = add((h2[:, 0], l2[:, 0]), (h2[:, 1], l2[:, 1]))
+    import jax
+
+    if jax.config.jax_enable_x64:
+        return jnp.sum(hi.astype(jnp.float64)) + jnp.sum(lo.astype(jnp.float64))
+    return jnp.sum(hi) + jnp.sum(lo)
+
+
+# ---------------------------------------------------------------------------
 # the df ops body (mirrors pallas_gates._ops_body per op kind)
 # ---------------------------------------------------------------------------
 
-def _ops_body_df(ops, xr, xi, *, tile_bits, gbit):
+def _ops_body_df(ops, xr, xi, *, tile_bits, gbit, accurate_add=False):
     """Apply a fused op run to one in-register df tile. ``xr``/``xi`` are
     (hi, lo) pairs of f32 arrays; returns new pairs. Mirrors
     pallas_gates._ops_body over the VPU op kinds; 'lane_u'/'window' MXU
     folds must not reach here (df plans never fold zones).
+    ``accurate_add`` swaps every df addition for the double-TwoSum variant
+    (QUEST_DF_ACCURATE_ADD; see :func:`df_add_accurate`) -- the flag is
+    part of the kernel signature so the jit caches never mix the two.
 
     Selection discipline: every conditional is an EXACT arithmetic select
     ``m*a + (1-m)*b`` with ``m`` an f32 plane of exact {0,1} values (one
@@ -175,6 +267,12 @@ def _ops_body_df(ops, xr, xi, *, tile_bits, gbit):
     vocabulary as the proven f32 kernel body. Boolean ``where`` with
     broadcast-constant branches SIGABRTs Mosaic (round-5 find)."""
     from .pallas_gates import _bit_mask, _keep_factor, _partner
+
+    # local rebinding: every df_add/df_sub below resolves to the selected
+    # variant (df_mul's internal sums are FastTwoSum, not df_add -- only
+    # the explicit additions differ between the two modes)
+    df_add = df_add_accurate if accurate_add else globals()["df_add"]
+    df_sub = df_sub_accurate if accurate_add else globals()["df_sub"]
 
     f32 = jnp.dtype("float32")
     shape = xr[0].shape

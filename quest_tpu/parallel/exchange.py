@@ -21,6 +21,17 @@ an improvement over shipping them into the exchange).
 
 Layout (see .mesh): device r of D=2^d holds flat indices [r*C, (r+1)*C);
 qubit q local iff q < nl = n-d; sharded qubit q is bit (q-nl) of r.
+
+Plane contract (round 7, the sharded double-float path): the DATA-MOVEMENT
+collectives (``dist_permute_bits``, ``dist_swap``'s sharded regimes, the
+``dist_apply_x`` chunk permute) are plane-agnostic -- they carry the planar
+(2, 2^n) pair or the PRECISION=2 double-float (4, 2^n) f32 layout natively,
+which is how per-shard df kernel runs are joined by the same grouped
+collectives as f32 plans. The ARITHMETIC kernels (pair exchange's blended
+update, diag/parity phases) stay planar: a df state REJOINS to (2, 2^n)
+f64 via the exact ``pallas_df.df_join`` before any of them runs -- the
+documented hi/lo plane-pair relabeling (both conversions are exact, so the
+round trip costs bandwidth, never precision).
 """
 
 from __future__ import annotations
@@ -239,20 +250,28 @@ def _permute_decompose(n: int, source, nl: int):
     return rho_src, sorted(Q_c), L_in, L_out, dest
 
 
-def permute_collective_stats(n: int, source, mesh: Mesh) -> dict:
+def permute_collective_stats(n: int, source, mesh: Mesh,
+                             unit_scale: float = 1.0) -> dict:
     """Trace-free cost model of :func:`dist_permute_bits`: number of
     collectives and chunk-units ((send+recv)/half-chunk pairs) it will pay.
     A relabel ppermute re-routes the full chunk (2 units, like a rank
     permute); the grouped all-to-all over m crossing bits moves
     (2^m - 1)/2^m of the chunk each way (2*(1 - 2^-m) units: m=1 is exactly
-    the odd-parity half-exchange's 1 unit)."""
+    the odd-parity half-exchange's 1 unit).
+
+    ``unit_scale`` restates the units for wider state layouts: 1 is the
+    planar f32 pair; the double-precision layouts -- planar f64, or the
+    double-float 4-plane f32 state the sharded PRECISION=2 fast path
+    permutes between per-shard kernel runs -- move twice the bytes per
+    chunk and price at ``unit_scale=2`` (the df 2x chunk-unit accounting,
+    scheduler.DistributedScheduler.apply_frame_permute)."""
     nl = local_qubit_count(n, mesh)
     rho_src, Q_c, _, _, _ = _permute_decompose(n, source, nl)
     m = len(Q_c)
     units = (2.0 if rho_src is not None else 0.0)
     units += 2.0 * (1.0 - 0.5 ** m) if m else 0.0
     return {"relabel_ppermute": rho_src is not None, "crossing_bits": m,
-            "chunk_units": units,
+            "chunk_units": units * unit_scale,
             "collectives": int(rho_src is not None) + int(m > 0)}
 
 
@@ -271,6 +290,12 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
       at once (each device sends (2^m-1)/2^m of its chunk for m crossing
       bits -- vs m full half-exchanges for m sequential swaps), then
     - one free in-chunk transpose for the local->local remainder.
+
+    Plane-agnostic (round 7): ``amps`` may carry any leading plane count --
+    the planar (2, 2^n) pair or the double-float (4, 2^n) layout the
+    sharded PRECISION=2 fast path permutes between per-shard kernel runs.
+    The permutation is pure data movement on the amplitude axis, so all
+    P planes ride the same relabel/all-to-all/transpose natively.
     """
     nl = local_qubit_count(n, mesh)
     source = tuple(source)
@@ -279,6 +304,7 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
     telemetry.inc("exchange_calls_total", kind="grouped_permute")
     rho_src, Q_c, L_in, L_out, dest = _permute_decompose(n, source, nl)
     m = len(Q_c)
+    P = amps.shape[0]
     size = mesh.shape[AMP_AXIS] if mesh is not None and mesh.size > 1 else 1
 
     if rho_src is not None:
@@ -305,9 +331,9 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
         groups = [sorted(v) for _, v in sorted(by_base.items())]
 
     def kernel(chunk):
-        # grouped view: axis 0 = re/im, then bits nl-1 .. 0 (bit b at axis
-        # 1 + (nl-1-b))
-        t = chunk.reshape((2,) + (2,) * nl)
+        # grouped view: axis 0 = the P planes (re/im, or the df 4-plane
+        # stack), then bits nl-1 .. 0 (bit b at axis 1 + (nl-1-b))
+        t = chunk.reshape((P,) + (2,) * nl)
 
         def ax(b):
             return 1 + (nl - 1 - b)
@@ -317,12 +343,12 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
             fset = set(front)
             rest = [a for a in range(1, nl + 1) if a not in fset]
             t = t.transpose(front + [0] + rest)
-            t = t.reshape((1 << m, 2) + (2,) * len(rest))
+            t = t.reshape((1 << m, P) + (2,) * len(rest))
             # piece j (chunk bits at L_in spell j) -> group member whose
             # device bits at Q_c spell j; received concat index j' = the
             # sender's Q_c device bits = the incoming values for L_out
             t = lax.all_to_all(t, AMP_AXIS, 0, 0, axis_index_groups=groups)
-            t = t.reshape((2,) * m + (2,) + (2,) * len(rest))
+            t = t.reshape((2,) * m + (P,) + (2,) * len(rest))
             src_axis = {}
             for k in range(m):
                 src_axis[L_out[k]] = m - 1 - k
@@ -335,7 +361,7 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
             # no crossings: only the local->local remainder moves
             src_axis = {dest[b]: ax(b) for b in range(nl)}
             t = t.transpose([0] + [src_axis[u] for u in range(nl - 1, -1, -1)])
-        return t.reshape(2, -1)
+        return t.reshape(P, -1)
 
     if mesh is None or mesh.size == 1:
         assert m == 0 and rho_src is None
@@ -429,6 +455,10 @@ def dist_swap(amps, *, n: int, qb1: int, qb2: int, mesh: Mesh):
     - mixed: odd-parity half-chunk exchange -- each device sends the half of
       its chunk whose local bit differs from its device bit, halving traffic
       vs a full exchange.
+
+    The sharded regimes are pure data movement and carry any leading plane
+    count (planar pair or the df 4-plane layout); the both-local regime
+    routes through the planar apply_swap kernel and takes (2, N) only.
     """
     nl = local_qubit_count(n, mesh)
     lo, hi = min(qb1, qb2), max(qb1, qb2)
@@ -457,9 +487,9 @@ def dist_swap(amps, *, n: int, qb1: int, qb2: int, mesh: Mesh):
         bitpos = hi - nl
         perm = [(i, i ^ (1 << bitpos)) for i in range(size)]
         b = _rank_bit(r, hi, nl)  # device's bit of qb2
-        # grouped view over the local qubit: (2, A, 2, B), axis 2 = lo's bit
+        # grouped view over the local qubit: (P, A, 2, B), axis 2 = lo's bit
         shape, axis_of = grouped_axes(nl, (lo,))
-        gshape = (2,) + shape
+        gshape = (own.shape[0],) + shape
         ax = axis_of[lo] + 1
         t = own.reshape(gshape)
         sub0 = lax.index_in_dim(t, 0, axis=ax, keepdims=False)

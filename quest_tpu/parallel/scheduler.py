@@ -53,6 +53,20 @@ def _cycle_swaps(occ, pos, n: int) -> list:
     return out
 
 
+def plane_unit_scale(amps) -> float:
+    """Chunk-unit scale of a state layout relative to the planar f32 pair
+    (8 bytes/amplitude): 1.0 for planar f32, 2.0 for BOTH double-precision
+    layouts -- planar f64 and the double-float 4-plane f32 state the
+    sharded PRECISION=2 fast path ships between per-shard kernel runs.
+    Only the pallas frame-transpose accounting uses this (the df 2x
+    chunk-unit rule); the gate-dispatch stats keep their historical
+    register-chunk units, with dtype width entering via
+    ``comm_volume(bytes_per_amp=...)`` as before."""
+    import numpy as np
+
+    return (amps.shape[0] * np.dtype(amps.dtype).itemsize) / 8.0
+
+
 def _swap_price(a: int, b: int, nl: int) -> float:
     """Chunk-units of one dist_swap, same prices as apply_swap: free when
     both positions are local, 1 (odd-parity half-exchange) when mixed,
@@ -106,6 +120,9 @@ class DistributedScheduler:
         "relocation_batches": 0, "relocation_batch_qubits": 0,
         "relocation_prefetched": 0, "relocation_batch_chunks": 0.0,
         "relocation_batch_swap_equiv_chunks": 0.0,
+        "frame_transpose_collectives": 0,
+        "frame_transpose_chunks": 0.0,
+        "frame_transpose_planar_chunks": 0.0,
         "ici_chunks": 0.0, "dcn_chunks": 0.0})
 
     def _count_comm(self, n: int, qubit: int, chunks: float,
@@ -305,6 +322,44 @@ class DistributedScheduler:
         self._pos = list(range(n))
         self._occ = list(range(n))
         return amps
+
+    def apply_frame_permute(self, amps, *, n, lo1, lo2, k):
+        """One pallas frame transpose -- the bit-block swap
+        [lo1, lo1+k) <-> [lo2, lo2+k) -- executed as the COUNTED grouped
+        permute collective (exchange.dist_permute_bits) instead of an
+        uncounted GSPMD transpose. This is how per-shard PallasRuns are
+        joined under the explicit scheduler (round 7, sharded df): the
+        state may be the planar pair or the double-float 4-plane layout,
+        and the chunk-unit accounting prices it by plane_unit_scale --
+        planar f32 = 1x, planar f64 / df 4-plane = 2x (the df chunk-unit
+        2x rule; `frame_transpose_planar_chunks` keeps the unscaled A/B
+        figure). Telemetry series kind="frame_transpose" sums exactly to
+        the model, as every other counted collective (tested)."""
+        source = list(range(n))
+        for j in range(k):
+            source[lo1 + j], source[lo2 + j] = source[lo2 + j], source[lo1 + j]
+        source = tuple(source)
+        scale = plane_unit_scale(amps)
+        cstats = X.permute_collective_stats(n, source, self.mesh)
+        nl = local_qubit_count(n, self.mesh)
+        self.stats["frame_transpose_collectives"] += cstats["collectives"]
+        self.stats["frame_transpose_chunks"] += cstats["chunk_units"] * scale
+        self.stats["frame_transpose_planar_chunks"] += cstats["chunk_units"]
+        # link attribution mirrors reconcile(): the all-to-all's volume is
+        # split evenly over the crossing shard bits, the relabel ppermute's
+        # over the relabeled bits
+        cross = [q for q in range(nl, n) if source[q] < nl]
+        if cross:
+            share = 2.0 * (1.0 - 0.5 ** len(cross)) * scale / len(cross)
+            for q in cross:
+                self._count_comm(n, q, share, kind="frame_transpose")
+        if cstats["relabel_ppermute"]:
+            moved = [q for q in range(nl, n)
+                     if source[q] >= nl and source[q] != q]
+            for q in moved:
+                self._count_comm(n, q, 2.0 * scale / len(moved),
+                                 kind="frame_transpose")
+        return X.dist_permute_bits(amps, n=n, source=source, mesh=self.mesh)
 
     def _pending_shard_uses(self, n, nl, exclude, capacity) -> list:
         """Sharded physical positions that tape entries between the cursor
@@ -644,36 +699,48 @@ def active() -> DistributedScheduler | None:
 def comm_chunks(stats: dict) -> float:
     """Total comm traffic of a plan in chunk units, the single source of
     the cost-model weights (2 per pair exchange / rank permute, 1 per
-    relocation swap, 0 for virtual swaps, plus ``reconcile_chunks`` and
-    ``relocation_batch_chunks`` -- the measured units of whichever
-    reconciliation / relocation policy ran, per-swap or collective) --
-    comm_volume() and every report derive from this."""
+    relocation swap, 0 for virtual swaps, plus ``reconcile_chunks``,
+    ``relocation_batch_chunks`` and ``frame_transpose_chunks`` -- the
+    measured units of whichever reconciliation / relocation policy ran,
+    per-swap or collective, and of the pallas frame transposes the
+    scheduler executed, df layouts priced at 2x) -- comm_volume() and
+    every report derive from this."""
     return (2.0 * stats["pair_exchanges"] + 1.0 * stats["relocation_swaps"]
             + 2.0 * stats["rank_permutes"]
             + stats.get("reconcile_chunks", 0.0)
-            + stats.get("relocation_batch_chunks", 0.0))
+            + stats.get("relocation_batch_chunks", 0.0)
+            + stats.get("frame_transpose_chunks", 0.0))
 
 
 def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
                  defer: bool = True, collective_reconcile: bool = True,
-                 batch_relocations: bool = True):
+                 batch_relocations: bool = True, dtype=None):
     """Trace ``circuit`` abstractly under the explicit scheduler and return
-    its communication plan stats (no device execution -- jax.eval_shape)."""
+    its communication plan stats (no device execution -- jax.eval_shape).
+    ``dtype`` sets the abstract register's amplitude dtype (default: the
+    process precision) -- an f64 plan whose fused tape takes the sharded
+    double-float route prices its frame transposes at the df 2x chunk-unit
+    scale, exactly as the executed replay counts them."""
     import jax
     import numpy as np
 
-    from ..precision import real_dtype
+    from ..precision import precision_for_dtype, real_dtype
 
+    if dtype is not None:
+        # an f64 plan needs jax x64 or eval_shape canonicalises the
+        # abstract register down to f32 (and the df route never engages)
+        real_dtype(precision_for_dtype(dtype))
+    dt = np.dtype(dtype) if dtype is not None else real_dtype(None)
     nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
     num_amps = 1 << nsv
     with explicit_mesh(mesh, num_slices=num_slices, defer=defer,
                        collective_reconcile=collective_reconcile,
                        batch_relocations=batch_relocations) as sched:
         fn = circuit.as_fn()
-        jax.eval_shape(fn, jax.ShapeDtypeStruct((2, num_amps), real_dtype(None)))
+        jax.eval_shape(fn, jax.ShapeDtypeStruct((2, num_amps), dt))
     if sched is None:
         return {}
     out = dict(sched.stats)
     out["comm_volume"] = sched.comm_volume(
-        nsv, bytes_per_amp=2 * np.dtype(real_dtype(None)).itemsize)
+        nsv, bytes_per_amp=2 * np.dtype(dt).itemsize)
     return out
