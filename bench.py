@@ -715,6 +715,125 @@ def plan_20q_relocation_smoke() -> dict:
     }
 
 
+def bench_serving(n: int, depth: int, reps: int) -> dict:
+    """CI-gate config ``serve_20q``: the serving engine's parameter-sweep
+    economics on an n-qubit VQE-style ansatz (every rotation a runtime
+    Param). Measures cold compile vs cached replay (the whole point of the
+    parameterized executable: the gate asserts cached replay < 10% of
+    cold), one coalesced batch-of-8 dispatch vs the same 8 requests
+    uncoalesced (bit-identical BY CONSTRUCTION -- both run the one padded
+    vmap program, asserted here and by the workflow), warm-path retraces
+    (must be zero) and the executable-cache hit counters, including the
+    structure-share hit when a second engine serves a fresh circuit of the
+    same structure."""
+    import time
+
+    import jax
+
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.engine import Engine, P
+
+    def ansatz():
+        circ = Circuit(n)
+        for layer in range(depth):
+            for q in range(n):
+                circ.rotateZ(q, P(f"a{layer}_{q}"))
+                circ.rotateX(q, P(f"b{layer}_{q}"))
+            for q in range(layer % 2, n - 1, 2):
+                circ.controlledNot(q, q + 1)
+            circ.controlledPhaseFlip(0, n - 1)
+        return circ
+
+    circ = ansatz()
+    names = circ.param_names
+    rng = np.random.RandomState(6)
+
+    def draw():
+        return {nm: float(v)
+                for nm, v in zip(names, rng.uniform(0, 2 * np.pi,
+                                                    len(names)))}
+
+    env = qt.createQuESTEnv(jax.devices()[:1])
+    eng = Engine(circ, env, max_batch=8, max_delay_ms=0.0)
+    h0 = telemetry.counter_value("plan_cache_hit_total", cache="executable")
+    m0 = telemetry.counter_value("plan_cache_miss_total", cache="executable")
+    t0 = time.perf_counter()
+    eng.run(draw()).block_until_ready()
+    cold_s = time.perf_counter() - t0
+    tr0 = telemetry.counter_value("engine_trace_total", kind="param_replay")
+    # warm batch-of-8: ONE coalesced vmap dispatch; the per-request warm
+    # latency (batch/8) is the serving-path "cached replay" the gate
+    # compares against the cold compile
+    sweep = [draw() for _ in range(8)]
+    best_batch = float("inf")
+    for _ in range(max(min(reps, 3), 1)):
+        tb = time.perf_counter()
+        outs = [f.result() for f in eng.submit_many(sweep)]
+        outs[-1].block_until_ready()
+        best_batch = min(best_batch, time.perf_counter() - tb)
+    batch_s = best_batch
+    # loop-of-8: the SAME 8 requests uncoalesced (each still runs the one
+    # padded program -- hence bit-identical lanes), timed per request
+    singles = []
+    louts = []
+    tl = time.perf_counter()
+    for p in sweep:
+        t1 = time.perf_counter()
+        r = eng.run(p)
+        r.block_until_ready()
+        singles.append(time.perf_counter() - t1)
+        louts.append(r)
+    loop_s = time.perf_counter() - tl
+    bitident = all(np.array_equal(np.asarray(a), np.asarray(b))
+                   for a, b in zip(outs, louts))
+    warm_retraces = telemetry.counter_value(
+        "engine_trace_total", kind="param_replay") - tr0
+    # structure share: a second engine over a FRESH circuit of the same
+    # structure serves from the executable cache -- no trace, no compile
+    # (the trace counter stays flat across its first request)
+    eng2 = Engine(ansatz(), env, max_batch=8, max_delay_ms=0.0)
+    tr1 = telemetry.counter_value("engine_trace_total", kind="param_replay")
+    t2 = time.perf_counter()
+    eng2.run(draw()).block_until_ready()
+    share_s = time.perf_counter() - t2
+    share_retraces = telemetry.counter_value(
+        "engine_trace_total", kind="param_replay") - tr1
+    eng2.close()
+    eng.close()
+    hits = telemetry.counter_value("plan_cache_hit_total",
+                                   cache="executable") - h0
+    misses = telemetry.counter_value("plan_cache_miss_total",
+                                     cache="executable") - m0
+    return {
+        "config": "serve_20q",
+        "metric": f"serving engine, {n}q depth-{depth} param ansatz: warm "
+                  "batched requests/sec (one vmap-over-params dispatch)",
+        "value": round(8 / batch_s, 2),
+        "unit": "req/sec",
+        "vs_baseline": None,
+        "detail": {
+            "qubits": n,
+            "depth": depth,
+            "num_params": len(names),
+            "cold_compile_ms": round(cold_s * 1e3, 1),
+            "cached_replay_ms": round(batch_s / 8 * 1e3, 2),
+            "replay_over_cold": round(batch_s / 8 / cold_s, 4),
+            "uncoalesced_replay_ms": round(min(singles) * 1e3, 2),
+            "batch8_ms": round(batch_s * 1e3, 2),
+            "loop8_ms": round(loop_s * 1e3, 2),
+            "batch_speedup": round(loop_s / batch_s, 2),
+            "batch_bitident": bool(bitident),
+            "warm_retraces": int(warm_retraces),
+            "plan_cache_hits": int(hits),
+            "plan_cache_misses": int(misses),
+            "structure_share_ms": round(share_s * 1e3, 2),
+            "structure_share_retraces": int(share_retraces),
+        },
+    }
+
+
 #: the committed full-detail artifact, written next to this file
 DETAIL_FILE = "BENCH_DETAIL.json"
 
@@ -809,7 +928,7 @@ def main() -> None:
     p.add_argument("--config",
                    choices=["all", "statevec", "density", "density_f64",
                             "f64", "plan_f64", "plan_34q_f64",
-                            "20q", "24q", "26q"],
+                            "20q", "24q", "26q", "serve"],
                    default="all",
                    help="all: every BASELINE.json milestone config (default);"
                         " statevec: one random Clifford+T run at --qubits;"
@@ -822,7 +941,10 @@ def main() -> None:
                         " plan_f64: the sharded 20q PRECISION=2 df comm"
                         " plan (CI smoke gate, df chunk-units at 2x);"
                         " plan_34q_f64: the 34q PRECISION=2 sharded df"
-                        " plan + deferred comm A/B")
+                        " plan + deferred comm A/B;"
+                        " serve: the serving-engine serve_20q config"
+                        " (cold vs cached replay, batch vs loop, cache"
+                        " hits)")
     p.add_argument("--emit", choices=["headline", "full"],
                    default="headline",
                    help="headline: compact <=1KB final line + "
@@ -923,6 +1045,10 @@ def main() -> None:
         r = plan_34q_f64()
         _emit(r, [r], args.emit)
         return
+    if args.config == "serve":
+        r = bench_serving(20, 2 if args.smoke else 4, args.reps)
+        _emit(r, [r], args.emit)
+        return
     if args.config in ("20q", "24q", "26q"):
         r = bench_statevec(int(args.config[:-1]), args.depth, args.reps,
                            sync)
@@ -935,6 +1061,10 @@ def main() -> None:
             # the CI bench-smoke gate asserts this config's relocation
             # A/B fields and its telemetry-vs-model cross-check
             cfgs.append(plan_20q_relocation_smoke())
+            # ... and the serving engine's serve_20q row: cached-replay
+            # vs cold-compile ratio, batch-vs-loop bit-identity, zero
+            # warm retraces, executable-cache hit counters
+            cfgs.append(bench_serving(20, 2, 3))
             # ... and the sharded PRECISION=2 df plan's presence, 2x df
             # chunk-unit accounting and zero f64-engine fallbacks
             # (QUEST_PRECISION is fixed at import: budgeted subprocess)
@@ -979,6 +1109,7 @@ def main() -> None:
                "PallasRuns for v5p-16 execution"))
     configs.append(plan_17q_density_distributed())
     configs.append(plan_20q_relocation_smoke())
+    configs.append(bench_serving(20, 4, args.reps))
     configs.append(_subprocess_config(
         ["--config", "plan_f64"], budget_s=1200,
         env={"QUEST_PRECISION": "2", "QUEST_PALLAS_DF": "1"},

@@ -56,5 +56,7 @@ from .reporting import *  # noqa: F401,F403
 from .checkpoint import saveQureg, loadQureg, writeStateToCSV  # noqa: F401
 from . import profiling  # noqa: F401
 from . import telemetry  # noqa: F401
+from . import engine  # noqa: F401
+from .engine import Engine, P, Param  # noqa: F401
 
 __version__ = "0.1.0"

@@ -27,6 +27,7 @@ import inspect
 
 import jax
 
+from . import telemetry
 from .registers import Qureg
 
 #: API names that can be recorded on a tape: mutate qureg.amps, need no host
@@ -220,7 +221,13 @@ class Circuit:
         self.num_qubits = int(num_qubits)
         self.is_density_matrix = bool(is_density_matrix)
         self._tape: list = []
-        self._compiled: dict = {}
+        # identity of this tape revision: executable-cache keys carry it, so
+        # mutating the tape invalidates them without any per-circuit dict
+        # (compiled replays live in the BOUNDED process-global LRU,
+        # engine.cache.executables(), with uniform hit/miss/evict telemetry)
+        self._cache_token = object()
+        self._lifted_cache = None
+        self._fp_cache = None
 
     # -- recording ----------------------------------------------------------
 
@@ -238,7 +245,9 @@ class Circuit:
     def append(self, fn, *args, **kwargs) -> "Circuit":
         """Record ``fn(qureg, *args, **kwargs)`` on the tape."""
         self._tape.append((fn, args, kwargs))
-        self._compiled.clear()
+        self._cache_token = object()
+        self._lifted_cache = None
+        self._fp_cache = None
         return self
 
     def __len__(self) -> int:
@@ -256,25 +265,46 @@ class Circuit:
         bypass the scheduler's coordinate remapping (state inits, phase
         functions, Pallas runs) are barriers; gate/channel/dense-block
         entries defer."""
+        return self._replay_fn(None)
+
+    def _replay_fn(self, lifted):
+        """The replay body behind :meth:`as_fn` (``lifted=None``) and the
+        parameterized executables (``lifted`` an engine.params.LiftedTape):
+        with a lifted tape the returned ``fn(amps, values)`` substitutes the
+        bound -- typically traced -- scalars into the slotted entries before
+        each application, so gate matrices assemble from runtime values
+        inside the one compiled program. Each trace of the parameterized
+        form counts ``engine_trace_total{kind=param_replay}`` (the retrace
+        detector the serving tests assert on)."""
         from .parallel import scheduler as _dist
 
         tape = tuple(self._tape)
+        entries = tuple(lifted.entries) if lifted is not None else None
         num_qubits, is_density = self.num_qubits, self.is_density_matrix
         nsv = (2 if is_density else 1) * num_qubits
 
         lookahead_cell = []  # memoized across retraces
 
-        def fn(amps):
+        def fn(amps, values=()):
+            if entries is None:
+                steps = tape
+            else:
+                from .engine.params import materialize_entry
+                telemetry.inc("engine_trace_total", kind="param_replay")
+                steps = [materialize_entry(e, values) for e in entries]
             shell = Qureg(num_qubits, is_density, amps, env=None)
             sched = _dist.active()
             started = sched.begin_defer() if sched is not None else False
             try:
                 if started:
                     if not lookahead_cell:
+                        # access sets come from the ORIGINAL tape: entries
+                        # carrying value slots fail capture and barrier,
+                        # identically for every values binding
                         lookahead_cell.append(_tape_accesses(
                             tape, num_qubits, is_density, shell.dtype))
                     sched.set_lookahead(*lookahead_cell[0])
-                for i, (f, args, kwargs) in enumerate(tape):
+                for i, (f, args, kwargs) in enumerate(steps):
                     if sched is not None and sched.deferring:
                         sched.advance(i)
                         if not _defer_safe(f):
@@ -294,7 +324,11 @@ class Circuit:
         return fn
 
     def compiled(self, donate: bool = True):
-        """The tape as one jitted executable, cached per execution mode.
+        """The tape as one jitted executable, cached per execution mode in
+        the process-global bounded LRU (engine.cache.executables(): uniform
+        eviction + ``plan_cache_{hit,miss,evict}_total`` telemetry -- the
+        per-circuit dict of earlier rounds grew without limit per
+        (mode, mesh) key).
 
         Gate routing (default GSPMD vs the explicit_mesh scheduler) is
         trace-time state, so the cache is keyed on the active scheduler's
@@ -302,12 +336,14 @@ class Circuit:
         silently replaying the other mode's executable.
         """
         from . import fusion
+        from .engine import cache as _ec
         from .parallel import scheduler as _dist
         sched = _dist.active()
         mesh = sched.mesh if sched else None
         pmesh = fusion.active_pallas_mesh()
-        key = (donate, mesh, pmesh)
-        if key not in self._compiled:
+        key = ("circuit", self._cache_token, donate, mesh, pmesh)
+
+        def build():
             inner = jax.jit(self.as_fn(), donate_argnums=(0,) if donate else ())
 
             def fn(amps, _inner=inner, _mesh=mesh, _pmesh=pmesh):
@@ -322,8 +358,77 @@ class Circuit:
                 with _dist.explicit_mesh(_mesh), fusion.pallas_mesh(pm):
                     return _inner(amps)
 
-            self._compiled[key] = fn
-        return self._compiled[key]
+            return fn
+
+        return _ec.executables().get_or_create(key, build)
+
+    # -- parameterized execution (the serving engine's entry points) --------
+
+    def lifted(self):
+        """This tape's :class:`~quest_tpu.engine.params.LiftedTape` (value
+        slots factored out of Params AND constant angles/Complex scalars),
+        memoized per tape revision."""
+        from .engine import params as _prm
+        tok = self._cache_token
+        if self._lifted_cache is None or self._lifted_cache[0] is not tok:
+            self._lifted_cache = (tok, _prm.lift_tape(tuple(self._tape)))
+        return self._lifted_cache[1]
+
+    @property
+    def param_names(self) -> tuple:
+        """Ordered unique :class:`~quest_tpu.engine.params.Param` names
+        recorded on the tape."""
+        return self.lifted().param_names
+
+    def fingerprint(self) -> str:
+        """Structure fingerprint of the tape (gate names, targets/controls,
+        value-slot kinds -- never the lifted values): the executable-cache
+        key under which structure-equal circuits share compiled replays.
+        See engine.cache.structure_fingerprint."""
+        from .engine import cache as _ec
+        tok = self._cache_token
+        if self._fp_cache is None or self._fp_cache[0] is not tok:
+            self._fp_cache = (tok, _ec.structure_fingerprint(
+                self._tape, self.num_qubits, self.is_density_matrix))
+        return self._fp_cache[1]
+
+    def parameterized(self, donate: bool = True):
+        """The tape as ONE jitted executable whose lifted values (Params and
+        constant angles/Complex scalars) are runtime arguments: a
+        :class:`~quest_tpu.engine.params.ParamExecutable` called as
+        ``exe(amps, {"theta": 0.3})``. Changing values never retraces --
+        gate matrices assemble from the traced scalars inside the program
+        (matrices.py traced branches), including between the static kernel
+        runs of a fused Pallas plan.
+
+        Cached in the global LRU keyed by (structure fingerprint, mode
+        meshes): two structure-equal circuits -- same ansatz, different
+        recorded angles -- share one compiled executable
+        (``plan_cache_hit_total``)."""
+        from . import fusion
+        from .engine import cache as _ec
+        from .engine.params import ParamExecutable
+        from .parallel import scheduler as _dist
+        sched = _dist.active()
+        mesh = sched.mesh if sched else None
+        pmesh = fusion.active_pallas_mesh()
+        lifted = self.lifted()
+        fp = self.fingerprint()
+        key = ("param", fp, donate, mesh, pmesh)
+
+        def build():
+            inner = jax.jit(self._replay_fn(lifted),
+                            donate_argnums=(0,) if donate else ())
+
+            def fn(amps, values, _inner=inner, _mesh=mesh, _pmesh=pmesh):
+                pm = _pmesh if _pmesh is not None else _amps_mesh(amps)
+                with _dist.explicit_mesh(_mesh), fusion.pallas_mesh(pm):
+                    return _inner(amps, values)
+
+            return fn
+
+        return ParamExecutable(_ec.executables().get_or_create(key, build),
+                               lifted, fp)
 
     def fused(self, max_qubits: int = 5, dtype=None,
               pallas: bool = False, shard_devices: int | None = None,
@@ -432,14 +537,17 @@ class Circuit:
 
     def compiled_blocks(self, max_gates: int, donate: bool = True):
         """Like :meth:`compiled`, but as a chain of block-sized executables.
-        Cached like :meth:`compiled` so repeated calls reuse the underlying
-        executables instead of retracing every block."""
+        Cached like :meth:`compiled` (the same bounded global LRU) so
+        repeated calls reuse the underlying executables instead of
+        retracing every block."""
         from . import fusion
+        from .engine import cache as _ec
         from .parallel import scheduler as _dist
         sched = _dist.active()
-        key = (("blocks", max_gates), donate, sched.mesh if sched else None,
-               fusion.active_pallas_mesh())
-        if key not in self._compiled:
+        key = ("circuit_blocks", self._cache_token, max_gates, donate,
+               sched.mesh if sched else None, fusion.active_pallas_mesh())
+
+        def build():
             fns = [b.compiled(donate=donate) for b in self.blocks(max_gates)]
 
             def chained(amps, _fns=tuple(fns)):
@@ -447,8 +555,9 @@ class Circuit:
                     amps = f(amps)
                 return amps
 
-            self._compiled[key] = chained
-        return self._compiled[key]
+            return chained
+
+        return _ec.executables().get_or_create(key, build)
 
     def run(self, qureg: Qureg) -> Qureg:
         """Apply the circuit to ``qureg`` (mutates its amps, like the C API)."""

@@ -217,6 +217,18 @@ def _capture_ctx(events: list):
          G._apply_gate_parity_phase, K.apply_swap) = saved
 
 
+def _entry_has_params(args, kwargs) -> bool:
+    """True when a tape entry carries engine.params.Param placeholders: the
+    planner never spy-captures it (there is no concrete matrix to fuse at
+    plan time) -- the entry passes through as a barrier whose matrix is
+    assembled from the traced runtime scalars at apply time, so the plan's
+    STRUCTURE stays value-independent and one compiled replay serves every
+    parameter vector."""
+    from .engine.params import has_params
+
+    return has_params(args, kwargs)
+
+
 def capture(fn, args, kwargs, num_qubits: int, dtype,
             is_density: bool = False, aux: bool = False) -> Optional[list]:
     """Replay one tape entry against a spy register; return its GateEvents,
@@ -926,6 +938,12 @@ def plan(tape, num_qubits: int, dtype, max_qubits: int = 5,
         cur = DiagBlock(qs, _event_diag(ev, qs))
 
     for fn, args, kwargs in tape:
+        if _entry_has_params(args, kwargs):
+            flush()
+            out.items.append((fn, args, kwargs))
+            out.num_barriers += 1
+            telemetry.inc("fusion_param_barriers_total", mode="dense")
+            continue
         events = capture(fn, args, kwargs, num_qubits, dtype)
         fusible = events is not None and all(
             (len(ev.support) <= max_diag_qubits) if _event_is_diag(ev)
@@ -1114,6 +1132,12 @@ def _plan_pallas(tape, num_qubits: int, dtype, max_qubits: int,
     # -- pass 1: resolve every tape entry (capture + lower + routability) --
     resolved = []  # ('barrier', entry) | ('events', [(ev, pops|None)])
     for fn, args, kwargs in tape:
+        if _entry_has_params(args, kwargs):
+            # runtime-parameter entry: apply-time-assembled barrier between
+            # the static kernel runs (see _entry_has_params)
+            telemetry.inc("fusion_param_barriers_total", mode="pallas")
+            resolved.append(("barrier", (fn, args, kwargs)))
+            continue
         events = capture(fn, args, kwargs, num_qubits, dtype,
                          is_density=is_density)
         lowered = None

@@ -395,6 +395,18 @@ def _multi_rotate_pauli(qureg, controls, targets, paulis, angle, func):
     active = [(t, c) for t, c in zip(targets, codes) if c != 0]
     if not active:
         # global phase exp(-i angle/2) on the controlled subspace
+        if matrices.is_traced(angle):
+            # runtime-parameter angle: assemble the phase inside the trace
+            import jax
+            import jax.numpy as jnp
+
+            ph = jax.lax.complex(jnp.cos(angle / 2), -jnp.sin(angle / 2))
+            if controls:
+                _apply_gate_diag(qureg, jnp.stack([jnp.ones_like(ph), ph]),
+                                 (controls[0],), tuple(controls[1:]))
+            else:
+                _apply_gate_diag(qureg, jnp.stack([ph, ph]), (targets[0],))
+            return
         if controls:
             _apply_gate_diag(qureg, np.array([1.0, np.exp(-0.5j * angle)]),
                              (controls[0],), tuple(controls[1:]))

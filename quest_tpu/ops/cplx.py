@@ -18,7 +18,17 @@ import numpy as np
 
 
 def from_complex(arr, dtype) -> jnp.ndarray:
-    """numpy complex array -> planar (2, *shape) device array."""
+    """Complex array -> planar (2, *shape) device array. Host numpy input
+    converts at trace time (the constant-matrix path); a jax array/tracer
+    input -- a gate matrix assembled from runtime parameters inside the
+    trace (quest_tpu.engine.params) -- splits into planes symbolically."""
+    import jax
+
+    if isinstance(arr, jax.Array):
+        a = jnp.asarray(arr)
+        if jnp.iscomplexobj(a):
+            return jnp.stack([jnp.real(a), jnp.imag(a)]).astype(dtype)
+        return jnp.stack([a, jnp.zeros_like(a)]).astype(dtype)
     a = np.asarray(arr)
     return jnp.asarray(np.stack([a.real, a.imag]), dtype=dtype)
 

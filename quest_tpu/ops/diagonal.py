@@ -92,8 +92,15 @@ def apply_parity_phase(amps, theta, *, n: int, qubits: tuple[int, ...],
     Computed elementwise over the flat state (no reshape, see
     :func:`_apply_diagonal_flat` for why): the factor is
     cos(theta/2) - i sin(theta/2) * (-1)^{parity of the target bits},
-    with the parity an XOR chain over index bits -- one fused VPU pass,
-    sharding-transparent. ``conj`` negates theta (density shadow op).
+    with the parity an XOR chain over index bits gathering from a 2-entry
+    phase table (the same formulation as :func:`_apply_diagonal_flat`) --
+    one fused VPU pass, sharding-transparent. The table gather, rather
+    than a multiply by the +-1 sign, keeps the kernel BIT-STABLE between
+    a constant-folded theta and a runtime-parameter theta (the serving
+    engine's parameterized replay): the sign-multiply form left the
+    trailing complex multiply eligible for FMA contraction in one
+    compilation but not the other, a 1-ulp divergence per parity gate.
+    ``conj`` negates theta (density shadow op).
     """
     num = amps.shape[-1]
     rdtype = amps.dtype
@@ -102,21 +109,21 @@ def apply_parity_phase(amps, theta, *, n: int, qubits: tuple[int, ...],
     for q in qubits:
         b = _flat_bits(num, q)
         par = b if par is None else par ^ b
-    sign = (1 - 2 * par).astype(rdtype)
 
     theta = jnp.asarray(theta, dtype=rdtype)
     if conj:
         theta = -theta
-    fr = jnp.cos(theta / 2) * jnp.ones_like(sign)
-    fi = -jnp.sin(theta / 2) * sign
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    fr = jnp.take(jnp.stack([c, c]), par[0])
+    fi = jnp.take(jnp.stack([-s, s]), par[0])
 
     if controls:
-        ok = _ctrl_ok(num, controls).astype(rdtype)
+        ok = _ctrl_ok(num, controls)[0].astype(rdtype)
         fr = 1 + ok * (fr - 1)
         fi = ok * fi
 
-    re = amps[0] * fr[0] - amps[1] * fi[0]
-    im = amps[0] * fi[0] + amps[1] * fr[0]
+    re = amps[0] * fr - amps[1] * fi
+    im = amps[0] * fi + amps[1] * fr
     return jnp.stack([re, im])
 
 
