@@ -80,6 +80,51 @@ def build_circuit(n: int, depth: int):
     return circ
 
 
+def serving_ansatz(n: int, depth: int):
+    """The serve_20q VQE-style ansatz (every rotation a runtime Param) --
+    shared by bench_serving and the static-analysis smoke specs."""
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.engine import P
+
+    circ = Circuit(n)
+    for layer in range(depth):
+        for q in range(n):
+            circ.rotateZ(q, P(f"a{layer}_{q}"))
+            circ.rotateX(q, P(f"b{layer}_{q}"))
+        for q in range(layer % 2, n - 1, 2):
+            circ.controlledNot(q, q + 1)
+        circ.controlledPhaseFlip(0, n - 1)
+    return circ
+
+
+def smoke_plan_specs() -> list:
+    """The ``--smoke`` plan configs in statically-checkable form -- the
+    ONE source shared by ``tools/lint.py --bench-plans`` and the tier-1
+    analysis gate (tests/test_analysis_smoke_plans.py). Each spec names a
+    config and how to verify it: ``build`` returns its circuit,
+    ``mesh_shape`` (or None) selects the comm-schedule check on an
+    abstract mesh, ``fused`` gives the Circuit.fused kwargs for the
+    frame/ring plan check (None = not a pallas-plan config), ``dtype``
+    the plan dtype. plan_20q_f64 needs a QUEST_PRECISION=2 process with
+    the df route enabled (QUEST_PALLAS_DF=1 off-TPU), as in main()."""
+    import numpy as np
+
+    return [
+        {"name": "plan_20q_relocation",
+         "build": lambda: build_circuit(20, 4),
+         "mesh_shape": (8,), "dtype": None, "fused": None},
+        {"name": "plan_20q_f64",
+         "build": lambda: build_circuit(20, 2),
+         "mesh_shape": (8,), "dtype": np.float64,
+         "fused": {"max_qubits": 5, "pallas": True, "shard_devices": 8,
+                   "dtype": np.float64}},
+        {"name": "serve_20q",
+         "build": lambda: serving_ansatz(20, 2),
+         "mesh_shape": None, "dtype": None,
+         "fused": {"max_qubits": 5, "pallas": True}},
+    ]
+
+
 #: the fast-window per-pass stream floor at 2^26 amps f32: the anchor that
 #: drift-normalises cross-session headline figures (scales linearly with
 #: state size). Measured with the SAME two-point-slope methodology as
@@ -732,21 +777,9 @@ def bench_serving(n: int, depth: int, reps: int) -> dict:
 
     import quest_tpu as qt
     from quest_tpu import telemetry
-    from quest_tpu.circuits import Circuit
-    from quest_tpu.engine import Engine, P
+    from quest_tpu.engine import Engine
 
-    def ansatz():
-        circ = Circuit(n)
-        for layer in range(depth):
-            for q in range(n):
-                circ.rotateZ(q, P(f"a{layer}_{q}"))
-                circ.rotateX(q, P(f"b{layer}_{q}"))
-            for q in range(layer % 2, n - 1, 2):
-                circ.controlledNot(q, q + 1)
-            circ.controlledPhaseFlip(0, n - 1)
-        return circ
-
-    circ = ansatz()
+    circ = serving_ansatz(n, depth)
     names = circ.param_names
     rng = np.random.RandomState(6)
 
@@ -793,7 +826,8 @@ def bench_serving(n: int, depth: int, reps: int) -> dict:
     # structure share: a second engine over a FRESH circuit of the same
     # structure serves from the executable cache -- no trace, no compile
     # (the trace counter stays flat across its first request)
-    eng2 = Engine(ansatz(), env, max_batch=8, max_delay_ms=0.0)
+    eng2 = Engine(serving_ansatz(n, depth), env, max_batch=8,
+                  max_delay_ms=0.0)
     tr1 = telemetry.counter_value("engine_trace_total", kind="param_replay")
     t2 = time.perf_counter()
     eng2.run(draw()).block_until_ready()

@@ -1,0 +1,104 @@
+"""Static-analysis subsystem: prove schedule invariants before execution.
+
+Three checkers over one diagnostics framework (:mod:`.diagnostics`;
+codes ``QT0xx`` lint / ``QT1xx`` plan / ``QT2xx`` kernel):
+
+- :mod:`.plancheck` -- symbolic FusePlan frame replay and scheduler
+  journal re-pricing (the model-vs-plan gate),
+- :mod:`.ringcheck` -- abstract DMA-ring pipeline hazard/VMEM proofs,
+- :mod:`.tapelint` -- GateEvent tape lints (cancellations, mergeable
+  rotations, param-lift candidates, apply-time traps).
+
+Reachable three ways: the ``tools/lint.py`` CLI, the pytest suites, and
+``QUEST_VERIFY=1`` runtime gating -- :func:`verify_plan` runs at
+``Circuit.fused()`` compile time, flight-records findings
+(``analysis_findings_total{code,severity}``) and raises
+:class:`AnalysisError` on error-severity findings. See docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .. import telemetry
+from .diagnostics import (CATALOG, SEVERITIES, AnalysisError, Finding,
+                          emit_findings, error_findings, make_finding,
+                          render_json, render_text, summarize)
+from .plancheck import (check_circuit_comm, check_plan, check_schedule,
+                        check_tape)
+from .ringcheck import check_events, check_ring, ring_events, sweep_reachable
+from .tapelint import lint_circuit, lint_events, lint_tape
+
+__all__ = [
+    "Finding", "AnalysisError", "CATALOG", "SEVERITIES",
+    "make_finding", "emit_findings", "error_findings",
+    "render_text", "render_json", "summarize",
+    "check_plan", "check_tape", "check_schedule", "check_circuit_comm",
+    "ring_events", "check_events", "check_ring", "sweep_reachable",
+    "lint_events", "lint_tape", "lint_circuit",
+    "verify_enabled", "verify_plan", "check_smoke_spec",
+]
+
+_VERIFY_ENV = "QUEST_VERIFY"
+
+
+def verify_enabled() -> bool:
+    """True when ``QUEST_VERIFY`` requests compile-time plan
+    verification (any value but empty/0/false/off)."""
+    return os.environ.get(_VERIFY_ENV, "").strip().lower() not in (
+        "", "0", "false", "off")
+
+
+def verify_plan(plan, *, nsv: int, dtype=None, shard_qubits=None,
+                location: str = "plan",
+                raise_on_error: bool = True, emit: bool = True):
+    """The ``QUEST_VERIFY=1`` gate: run :func:`check_plan`, flight-record
+    the findings, and raise :class:`AnalysisError` when any carry error
+    severity. Returns the findings for callers that want them."""
+    findings = check_plan(plan, nsv, dtype=dtype,
+                          shard_qubits=shard_qubits, location=location)
+    if emit:
+        emit_findings(findings)
+        telemetry.inc("analysis_plans_verified_total")
+    if raise_on_error and error_findings(findings):
+        raise AnalysisError(findings)
+    return findings
+
+
+def check_smoke_spec(spec: dict) -> list:
+    """Run every applicable checker over one bench smoke-plan spec (a
+    ``bench.smoke_plan_specs()`` row): tape lint always; the frame/ring
+    plan check when the spec carries ``fused`` kwargs; the comm-schedule
+    re-pricing when it names a ``mesh_shape`` (on the fused circuit when
+    one was built, matching what the bench config itself plans).
+    Returns the concatenated findings -- the one implementation behind
+    ``tools/lint.py --bench-plans`` and the tier-1 analysis gate."""
+    from .._compat import abstract_mesh
+    from ..environment import AMP_AXIS
+
+    name = spec["name"]
+    circ = spec["build"]()
+    findings = lint_tape(list(circ._tape), circ.num_qubits,
+                         is_density=circ.is_density_matrix,
+                         location=f"{name}.tape")
+    fz = None
+    if spec.get("fused"):
+        kw = dict(spec["fused"])
+        fz = circ.fused(**kw)
+        # frame grid blocks may reach sharded qubits (collective
+        # transposes), so the plan is verified over the FULL space; the
+        # DMA-ring grid, though, is what one shard's kernel sweeps
+        nsv = (2 if circ.is_density_matrix else 1) * circ.num_qubits
+        d = int(kw.get("shard_devices") or 1)
+        shard_q = nsv - (d.bit_length() - 1) if d > 1 else None
+        findings += check_tape(fz._tape, nsv, dtype=kw.get("dtype"),
+                               shard_qubits=shard_q,
+                               location=f"{name}.plan")
+    if spec.get("mesh_shape"):
+        mesh = abstract_mesh(tuple(spec["mesh_shape"]), (AMP_AXIS,))
+        target = fz if fz is not None else circ
+        sched_findings, _stats, _journal = check_circuit_comm(
+            target, mesh, dtype=spec.get("dtype"),
+            location=f"{name}.schedule")
+        findings += sched_findings
+    return findings

@@ -1,0 +1,191 @@
+"""Diagnostics framework for the static-analysis subsystem.
+
+Every checker (:mod:`.plancheck`, :mod:`.ringcheck`, :mod:`.tapelint`)
+reports :class:`Finding` records drawn from one code catalog:
+
+- ``QT0xx`` -- tape lint (circuit-level advice and apply-time traps),
+- ``QT1xx`` -- plan verification (FusePlan frames, scheduler journals,
+  chunk-unit pricing),
+- ``QT2xx`` -- kernel/DMA-ring checks (slot hazards, VMEM budget, ring
+  configuration).
+
+Each finding carries a severity (``error`` | ``warning`` | ``info``), a
+human-readable location and a one-line fix hint. :func:`emit_findings`
+flight-records findings on the telemetry registry
+(``analysis_findings_total{code,severity}``) so verified runs leave the
+same parseable trail as every other engine subsystem
+(docs/observability.md).
+
+This module deliberately imports nothing heavier than
+:mod:`quest_tpu.telemetry`, so low-level modules (ops.pallas_gates) can
+report diagnostics without import cycles.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+from typing import Iterable, Optional
+
+from .. import telemetry
+
+__all__ = [
+    "Finding", "AnalysisError", "CATALOG", "SEVERITIES",
+    "make_finding", "emit_findings", "error_findings",
+    "render_text", "render_json", "summarize",
+]
+
+#: severity levels, most severe first
+SEVERITIES: tuple[str, ...] = ("error", "warning", "info")
+
+#: code -> (default severity, title, default fix hint)
+CATALOG: dict[str, tuple[str, str, str]] = {
+    # -- QT0xx: tape lint ---------------------------------------------------
+    "QT001": ("warning", "adjacent self-inverse gate pair cancels",
+              "delete both gates; they compose to the identity"),
+    "QT002": ("info", "adjacent same-axis rotations are mergeable",
+              "merge into one rotation of the summed angle"),
+    "QT003": ("info", "constant angles at liftable positions defeat the "
+                      "structure-fingerprint cache",
+              "record the angles as engine.P(...) Params so "
+              "structure-equal circuits share one compiled executable"),
+    "QT004": ("error", "control/target overlap in a captured gate event",
+              "use disjoint control and target qubits; this only fails "
+              "at apply time"),
+    # -- QT1xx: plan verification -------------------------------------------
+    "QT101": ("error", "dense kernel-op target outside the legal "
+                       "physical tile",
+              "re-plan: dense targets must sit below tile_bits in the "
+              "run's frame"),
+    "QT102": ("error", "frame permutation does not compose back to "
+                       "identity",
+              "the folded load/store swaps and FrameSwap items must "
+              "restore the identity frame before any non-Pallas item"),
+    "QT103": ("error", "chunk-unit totals diverge from the plan_circuit "
+                       "pricing model",
+              "re-derive the per-kind prices (_swap_price, "
+              "permute_collective_stats, plane_unit_scale) against the "
+              "scheduler stats"),
+    "QT104": ("error", "relocation schedule does not restore the tracked "
+                       "layout at reconcile",
+              "every deferred relocation/virtual swap must be matched by "
+              "the reconcile permute or swap chain"),
+    "QT105": ("error", "kernel-op control/target overlap inside a "
+                       "PallasRun",
+              "the lowered op reuses a qubit in both roles; fix the "
+              "lowering or the source gate"),
+    "QT106": ("error", "folded frame-swap geometry exceeds the run "
+                       "geometry",
+              "k must be <= tile_bits - LANE_BITS, hi >= tile_bits and "
+              "hi + k <= n for the kernel's bit-block swap"),
+    # -- QT2xx: kernel / DMA ring -------------------------------------------
+    "QT201": ("error", "DMA ring load-slot hazard",
+              "a ring slot's load must start, be waited, and be consumed "
+              "by exactly one compute before the slot is refilled"),
+    "QT202": ("error", "DMA ring store-slot hazard or unpaired copy/wait",
+              "a slot's previous store must drain (store-wait at "
+              "c - ring) before its output buffer is rewritten, and "
+              "every started copy must be waited"),
+    "QT203": ("error", "ring VMEM budget exceeded at minimum depth",
+              "even the 2-slot ring does not fit _RING_VMEM_BUDGET; "
+              "shrink the tile (sublanes) or raise the budget"),
+    "QT204": ("info", "ring depth clamped or derated from the requested "
+                      "operating point",
+              "the effective ring is capped by the chunk count and the "
+              "VMEM budget; request a smaller depth to silence this"),
+    "QT205": ("warning", "QUEST_PALLAS_RING is malformed or out of range",
+              "set QUEST_PALLAS_RING to an integer >= 2 (the 2-slot "
+              "minimum); the malformed value was replaced"),
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: a catalog code, its severity, where it was found,
+    what is wrong, and a one-line fix hint."""
+
+    code: str
+    severity: str
+    message: str
+    location: str
+    hint: str
+
+    def __str__(self) -> str:
+        return (f"{self.code} [{self.severity}] {self.location}: "
+                f"{self.message} ({self.hint})")
+
+
+class AnalysisError(Exception):
+    """Raised by the ``QUEST_VERIFY=1`` gate on error-severity findings.
+
+    Carries the full finding list on ``.findings`` so callers (and tests)
+    can inspect exactly which invariants failed."""
+
+    def __init__(self, findings: list[Finding]):
+        self.findings = list(findings)
+        errs = [f for f in findings if f.severity == "error"]
+        super().__init__(
+            f"{len(errs)} error-severity analysis finding(s):\n"
+            + "\n".join(f"  {f}" for f in errs))
+
+
+def make_finding(code: str, message: str, location: str,
+                 hint: Optional[str] = None,
+                 severity: Optional[str] = None) -> Finding:
+    """Build a :class:`Finding`, defaulting severity and hint from the
+    catalog entry for ``code`` (which must exist)."""
+    default_sev, _title, default_hint = CATALOG[code]
+    sev = severity if severity is not None else default_sev
+    if sev not in SEVERITIES:
+        raise ValueError(f"unknown severity {sev!r}; pick from {SEVERITIES}")
+    return Finding(code=code, severity=sev, message=message,
+                   location=location,
+                   hint=hint if hint is not None else default_hint)
+
+
+def emit_findings(findings: Iterable[Finding]) -> None:
+    """Flight-record findings on the telemetry registry:
+    ``analysis_findings_total{code,severity}`` (one increment each)."""
+    for f in findings:
+        telemetry.inc("analysis_findings_total", code=f.code,
+                      severity=f.severity)
+
+
+def error_findings(findings: Iterable[Finding]) -> list[Finding]:
+    """The error-severity subset, in order."""
+    return [f for f in findings if f.severity == "error"]
+
+
+def summarize(findings: Iterable[Finding]) -> dict:
+    """Aggregate counts: total, per severity, and per code -- the shape
+    the dryrun's ``# analysis:`` line and the CLI summary print."""
+    fs = list(findings)
+    by_sev = {s: 0 for s in SEVERITIES}
+    by_code: dict[str, int] = {}
+    for f in fs:
+        by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+        by_code[f.code] = by_code.get(f.code, 0) + 1
+    return {"total": len(fs), "by_severity": by_sev,
+            "by_code": dict(sorted(by_code.items()))}
+
+
+def render_text(findings: Iterable[Finding]) -> str:
+    """Human-readable report, most severe first, stable within severity."""
+    fs = sorted(findings, key=lambda f: (SEVERITIES.index(f.severity),
+                                         f.code, f.location))
+    if not fs:
+        return "no findings"
+    lines = [str(f) for f in fs]
+    s = summarize(fs)
+    lines.append(f"-- {s['total']} finding(s): "
+                 + ", ".join(f"{n} {sev}" for sev, n in
+                             s["by_severity"].items() if n))
+    return "\n".join(lines)
+
+
+def render_json(findings: Iterable[Finding]) -> str:
+    """Machine-readable report: ``{"findings": [...], "summary": {...}}``
+    -- the shape the CI lint gate parses."""
+    fs = list(findings)
+    return json.dumps({"findings": [asdict(f) for f in fs],
+                       "summary": summarize(fs)}, sort_keys=True)

@@ -1,0 +1,340 @@
+"""Plan verifier: prove FusePlan frame / scheduler-journal invariants.
+
+Two symbolic replays, both zero-device:
+
+**Frames** (:func:`check_plan`): a ``FusePlan`` interleaves PallasRuns
+(ops pre-relabeled into PHYSICAL coordinates), folded load/store frame
+swaps, standalone ``FrameSwap`` transposes, and non-Pallas items that
+require the identity frame (the planner's contract -- see the FrameSwap
+docstring in :mod:`..fusion`). The checker composes every bit-block swap
+over an explicit position permutation and proves
+
+- every dense kernel-op target lands below ``tile_bits`` in its run's
+  frame (QT101) with no control/target aliasing (QT105),
+- every folded swap's geometry fits the kernel's sublane/grid blocks
+  (QT106, the static twin of ``_fused_local_run``'s runtime ValueError),
+- the composed permutation returns to identity before any non-Pallas
+  item and at plan end (QT102),
+- each run's DMA-ring operating point is hazard-free and in budget
+  (delegated to :mod:`.ringcheck`).
+
+**Comm schedule** (:func:`check_schedule`): the explicit scheduler
+journals every communication decision (``DistributedScheduler.journal``:
+pair exchanges, dist swaps, rank/grouped permutes, virtual swaps,
+reconcile chains and collectives). The checker re-prices each record
+from first principles (:func:`.._swap_price`,
+:func:`..parallel.exchange.permute_collective_stats`,
+``plane_unit_scale`` -- the df 2x rule) and replays the layout shadow,
+proving the deferred relocations and ``dist_permute_bits`` batches
+compose back to the tracked permutation at every ``reconcile`` (QT104)
+and that the recomputed chunk-unit totals equal the ``plan_circuit``
+stats per kind (QT103) -- a model-vs-plan gate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .diagnostics import Finding, make_finding
+from .ringcheck import check_ring
+
+__all__ = ["swap_position", "check_plan", "check_tape",
+           "check_schedule", "check_circuit_comm"]
+
+#: float tolerance for chunk-unit total comparisons
+_TOL = 1e-6
+
+
+def swap_position(p: int, tile_bits: int, k: int, hi: Optional[int]) -> int:
+    """Where physical position ``p`` lands under the k-bit block swap of
+    sublane block [tile_bits-k, tile_bits) with grid block [hi, hi+k)
+    (hi = None means tile_bits) -- the single position map every frame
+    event in a plan composes through."""
+    h = tile_bits if hi is None else hi
+    lo = tile_bits - k
+    if lo <= p < tile_bits:
+        return p - lo + h
+    if h <= p < h + k:
+        return p - h + lo
+    return p
+
+
+def _op_overlap_findings(op: tuple, where: str) -> list[Finding]:
+    """QT105: control/target aliasing inside one lowered kernel op."""
+    findings: list[Finding] = []
+
+    def bad(msg: str) -> None:
+        findings.append(make_finding("QT105", msg, where))
+
+    kind = op[0]
+    if kind == "matrix":
+        t, controls = op[1], op[2]
+        if t in controls:
+            bad(f"matrix target {t} is also a control")
+    elif kind == "swap":
+        q1, q2, controls = op[1], op[2], op[3]
+        if q1 == q2:
+            bad(f"swap targets alias (both {q1})")
+        for q in (q1, q2):
+            if q in controls:
+                bad(f"swap target {q} is also a control")
+    elif kind in ("parity", "diagw"):
+        targets, controls = tuple(op[1]), tuple(op[2])
+        if len(set(targets)) != len(targets):
+            bad(f"{kind} repeats a target in {targets}")
+        overlap = set(targets) & set(controls)
+        if overlap:
+            bad(f"{kind} targets {sorted(overlap)} are also controls")
+    # kraus1/kraus2/krausn/lane_u/window: target disjointness is
+    # structural in their tuple layouts (validated at lowering)
+    return findings
+
+
+def check_plan(plan, nsv: int, *, dtype=None,
+               shard_qubits: Optional[int] = None,
+               check_rings: bool = True,
+               location: str = "plan") -> list[Finding]:
+    """Symbolically replay ``plan`` over ``nsv`` state-vector qubits; see
+    the module docstring for the proven invariant set. ``dtype`` selects
+    the ring geometry (planar f32/f64 or, when the double-float route is
+    enabled, the 4-plane f32 layout). ``shard_qubits`` (shard-LOCAL
+    qubit count of a sharded plan) bounds each run's DMA-ring grid to
+    what one shard's kernel actually sweeps; frames are always verified
+    over the full ``nsv`` space (grid blocks may reach sharded
+    qubits)."""
+    import numpy as np
+
+    from ..fusion import DiagBlock, FrameSwap, FusedBlock, PallasRun
+    from ..ops.pallas_gates import (LANE_BITS, _LANES, op_dense_targets,
+                                    ring_depth_default)
+
+    findings: list[Finding] = []
+    perm = list(range(nsv))  # physical position -> original position
+    identity = list(range(nsv))
+
+    dt = np.dtype(dtype) if dtype is not None else None
+    df = False
+    if dt is not None and dt == np.float64:
+        from ..ops.pallas_df import df_wanted
+        df = df_wanted()
+
+    def apply_swap_event(tile_bits: int, k: int, hi: Optional[int],
+                         where: str) -> None:
+        nonlocal perm
+        h = tile_bits if hi is None else hi
+        if (k > tile_bits - LANE_BITS or h < tile_bits
+                or h + k > nsv or k < 0):
+            findings.append(make_finding(
+                "QT106",
+                f"block swap k={k}, hi={h} illegal for tile_bits="
+                f"{tile_bits}, n={nsv} (sublane block has "
+                f"{tile_bits - LANE_BITS} bits)", where))
+            return
+        if k == 0:
+            return
+        perm = [swap_position(perm[p], tile_bits, k, hi)
+                for p in range(nsv)]
+
+    for i, item in enumerate(plan.items):
+        where = f"{location}.items[{i}]"
+        if isinstance(item, PallasRun):
+            apply_swap_event(item.tile_bits, item.load_swap_k,
+                             item.load_swap_hi, where + ".load_swap")
+            for j, op in enumerate(item.ops):
+                opw = f"{where}.ops[{j}]:{op[0]}"
+                for t in op_dense_targets(op):
+                    if not (0 <= t < item.tile_bits):
+                        findings.append(make_finding(
+                            "QT101",
+                            f"dense target {t} outside the physical tile "
+                            f"[0, {item.tile_bits}) in this run's frame",
+                            opw))
+                findings.extend(_op_overlap_findings(op, opw))
+            apply_swap_event(item.tile_bits, item.store_swap_k,
+                             item.store_swap_hi, where + ".store_swap")
+            if check_rings:
+                kernel_n = nsv if shard_qubits is None else shard_qubits
+                grid = 1 << max(kernel_n - item.tile_bits, 0)
+                if grid > 1:
+                    planes = 4 if df else 2
+                    itemsize = 4 if df or dt is None else dt.itemsize
+                    s = 1 << (item.tile_bits - LANE_BITS)
+                    depth = (item.ring_depth if item.ring_depth is not None
+                             else ring_depth_default())
+                    findings.extend(check_ring(
+                        grid, depth, planes * s * _LANES * itemsize,
+                        location=where + ".ring"))
+        elif isinstance(item, FrameSwap):
+            apply_swap_event(item.tile_bits, item.k, item.hi, where)
+        elif isinstance(item, (FusedBlock, DiagBlock)) or \
+                isinstance(item, tuple):
+            if perm != identity:
+                moved = [p for p in range(nsv) if perm[p] != p]
+                findings.append(make_finding(
+                    "QT102",
+                    f"non-Pallas item reached with a live frame "
+                    f"(positions {moved[:8]} displaced)", where))
+                perm = list(identity)  # report once, keep checking
+    if perm != identity:
+        moved = [p for p in range(nsv) if perm[p] != p]
+        findings.append(make_finding(
+            "QT102",
+            f"plan ends with a live frame (positions {moved[:8]} "
+            f"displaced); the planner must restore identity",
+            f"{location}.end"))
+    return findings
+
+
+def check_tape(tape, nsv: int, **kwargs) -> list[Finding]:
+    """:func:`check_plan` over a ``Circuit`` tape (the executed form):
+    decode it back to a FusePlan via :func:`..fusion.plan_from_tape`."""
+    from ..fusion import plan_from_tape
+
+    return check_plan(plan_from_tape(tape), nsv, **kwargs)
+
+
+def check_schedule(journal: list, stats: dict, n: int, mesh, *,
+                   location: str = "schedule") -> list[Finding]:
+    """Re-price and layout-replay a scheduler journal against its
+    ``plan_circuit`` stats (see the module docstring). ``journal`` is the
+    record list a :class:`..parallel.scheduler.DistributedScheduler`
+    collects when its ``journal`` attribute is set."""
+    from ..parallel import exchange as X
+    from ..parallel.mesh import local_qubit_count
+    from ..parallel.scheduler import _swap_price
+
+    findings: list[Finding] = []
+    nl = local_qubit_count(n, mesh)
+    pos = list(range(n))   # logical -> physical shadow
+    occ = list(range(n))   # physical -> logical shadow
+
+    def shadow_swap(a: int, b: int) -> None:
+        la, lb = occ[a], occ[b]
+        occ[a], occ[b] = lb, la
+        pos[la], pos[lb] = b, a
+
+    totals = {"pair_exchanges": 0, "rank_permutes": 0,
+              "relocation_swaps": 0, "virtual_swaps": 0,
+              "reconcile_chunks": 0.0, "relocation_batch_chunks": 0.0,
+              "frame_transpose_chunks": 0.0}
+
+    for idx, rec in enumerate(journal):
+        where = f"{location}[{idx}]:{rec[0]}"
+        kind = rec[0]
+        if kind == "pair_exchange":
+            totals["pair_exchanges"] += 1
+        elif kind == "rank_permute":
+            _, rn, q = rec
+            if q < nl:
+                findings.append(make_finding(
+                    "QT103", f"rank permute on local position {q} "
+                             f"(< {nl}) would be free, not 2 units",
+                    where))
+            totals["rank_permutes"] += 1
+        elif kind == "dist_swap":
+            _, rn, a, b, tracked = rec
+            price = _swap_price(a, b, nl)
+            if abs(price - 1.0) > _TOL:
+                findings.append(make_finding(
+                    "QT103",
+                    f"dist_swap({a},{b}) priced {price} chunk-units; "
+                    f"the relocation path budgets exactly 1.0 "
+                    f"(one local, one sharded position)", where))
+            totals["relocation_swaps"] += 1
+            if tracked:
+                shadow_swap(a, b)
+        elif kind == "virtual_swap":
+            _, p1, p2 = rec
+            totals["virtual_swaps"] += 1
+            shadow_swap(p1, p2)
+        elif kind == "reconcile_swap":
+            _, rn, a, b = rec
+            totals["reconcile_chunks"] += _swap_price(a, b, nl)
+            shadow_swap(a, b)
+        elif kind == "permute":
+            _, rn, source, scale, pkind = rec
+            cstats = X.permute_collective_stats(rn, tuple(source), mesh)
+            units = cstats["chunk_units"] * float(scale)
+            if pkind == "reconciliation":
+                totals["reconcile_chunks"] += units
+                if tuple(pos) != tuple(source):
+                    findings.append(make_finding(
+                        "QT104",
+                        f"reconcile collective permutes by {source} but "
+                        f"the tracked layout is {tuple(pos)}: the "
+                        f"deferred schedule diverged", where))
+                pos = list(range(rn))
+                occ = list(range(rn))
+            elif pkind == "relocation_batch":
+                totals["relocation_batch_chunks"] += units
+                for a in range(rn):
+                    b = source[a]
+                    if a < b:
+                        shadow_swap(a, b)
+            elif pkind == "frame_transpose":
+                # frame transposes permute amplitudes without touching
+                # the scheduler's logical layout (the pallas plan itself
+                # carries the frame); only the pricing is checked
+                totals["frame_transpose_chunks"] += units
+            else:
+                findings.append(make_finding(
+                    "QT103", f"unknown permute kind {pkind!r}", where))
+        elif kind == "reconcile_done":
+            if pos != list(range(n)):
+                moved = [q for q in range(n) if pos[q] != q]
+                findings.append(make_finding(
+                    "QT104",
+                    f"reconcile completed but the replayed layout is "
+                    f"not identity (logical qubits {moved[:8]} "
+                    f"displaced): a relocation/virtual swap was dropped "
+                    f"or double-counted", where))
+                pos = list(range(n))
+                occ = list(range(n))
+        else:
+            findings.append(make_finding(
+                "QT103", f"unknown journal record kind {kind!r}", where))
+
+    for key in ("pair_exchanges", "rank_permutes", "relocation_swaps",
+                "virtual_swaps"):
+        if totals[key] != stats.get(key, 0):
+            findings.append(make_finding(
+                "QT103",
+                f"journal replays {totals[key]} {key} but the plan "
+                f"stats claim {stats.get(key, 0)}",
+                f"{location}.totals"))
+    for key in ("reconcile_chunks", "relocation_batch_chunks",
+                "frame_transpose_chunks"):
+        if abs(totals[key] - float(stats.get(key, 0.0))) > _TOL:
+            findings.append(make_finding(
+                "QT103",
+                f"recomputed {key} = {totals[key]:.6g} chunk-units but "
+                f"the plan stats claim {float(stats.get(key, 0.0)):.6g}",
+                f"{location}.totals"))
+    if pos != list(range(n)):
+        moved = [q for q in range(n) if pos[q] != q]
+        findings.append(make_finding(
+            "QT104",
+            f"schedule ends with logical qubits {moved[:8]} displaced "
+            f"and no reconcile", f"{location}.end"))
+    return findings
+
+
+def check_circuit_comm(circuit, mesh, *, num_slices: int = 1,
+                       dtype=None, defer: bool = True,
+                       collective_reconcile: bool = True,
+                       batch_relocations: bool = True,
+                       location: str = "plan_circuit"):
+    """Plan ``circuit`` abstractly (zero devices) with journaling on and
+    verify the journal against the returned stats. Returns
+    ``(findings, stats, journal)``."""
+    from ..parallel.scheduler import plan_circuit
+
+    journal: list = []
+    stats = plan_circuit(circuit, mesh, num_slices=num_slices,
+                         defer=defer,
+                         collective_reconcile=collective_reconcile,
+                         batch_relocations=batch_relocations,
+                         dtype=dtype, journal=journal)
+    n = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
+    findings = check_schedule(journal, stats, n, mesh, location=location)
+    return findings, stats, journal

@@ -1,0 +1,239 @@
+"""DMA-ring schedule checker: prove the manual ring pipeline hazard-free.
+
+:func:`quest_tpu.ops.pallas_gates._make_dma_kernel` owns a whole fused
+pass as ONE Pallas program looping over the ``2^grid`` chunks through an
+N-slot in-flight ring: the prologue fills ``ring - 1`` load slots, the
+steady-state loop prefetches chunk ``c + ring - 1`` while computing chunk
+``c``, and a store only blocks when its slot comes around again ``ring``
+chunks later (the store-wait at ``c - ring``). That event order is a
+static schedule over ``(slot, chunk)`` pairs -- so its safety invariants
+are provable without running the kernel:
+
+- **load-slot hazards** (QT201): every load is started before it is
+  waited, waited before its chunk is computed, and its slot is not
+  refilled until the compute consumed it (no WAR/RAW on ``ins``);
+- **store-slot hazards** (QT202): a slot's output buffer is not
+  rewritten while its previous store is still draining, stores start
+  only after the slot was written, and every started copy is waited
+  exactly once by program end (copy/wait pairing);
+- **VMEM budget** (QT203/QT204): the in+out ring buffers
+  (``2 * ring * slot_bytes``) fit ``_RING_VMEM_BUDGET`` after the
+  caller's clamp/derate (:func:`..ops.pallas_gates.effective_ring_depth`
+  -- the ONE clamp both the kernel caller and this checker use).
+
+:func:`ring_events` generates the exact event sequence of the kernel's
+pipeline and exposes fault-injection knobs (``store_wait_offset``,
+``prologue_fill``, ``skip_final_waits``) so the mutation tests can seed
+the classic off-by-one bugs and prove :func:`check_events` catches them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .diagnostics import Finding, make_finding
+
+__all__ = ["ring_events", "check_events", "check_ring",
+           "sweep_reachable", "REACHABLE_GEOMETRIES"]
+
+#: one simulated event: (kind, slot, chunk) with kind in
+#: load_start | load_wait | compute | store_write | store_start | store_wait
+Event = tuple
+
+#: tile geometries reachable from plan knobs, as (label, planes, sublane
+#: rows, itemsize): the planar f32 pair at the default S=4096 tile, the
+#: native-f64 interpreter geometry (same tile, 8-byte elements), and the
+#: double-float 4-plane f32 layout at its tuned smaller tile
+#: (ops.pallas_df.DF_SUBLANES).
+REACHABLE_GEOMETRIES: tuple[tuple[str, int, int, int], ...] = (
+    ("f32", 2, 4096, 4),
+    ("f64", 2, 4096, 8),
+    ("df", 4, 1024, 4),
+)
+
+
+def ring_events(nchunks: int, ring: int, *,
+                store_wait_offset: int = 0,
+                prologue_fill: Optional[int] = None,
+                skip_final_waits: bool = False) -> list[Event]:
+    """The event sequence of ``_make_dma_kernel``'s pipeline for
+    ``nchunks`` chunks at ring depth ``ring`` (callers pass the already
+    clamped depth). The keyword knobs inject schedule defects for
+    mutation testing -- the defaults reproduce the kernel exactly:
+
+    - ``store_wait_offset=1`` delays the store-wait guard by one chunk
+      (the classic off-by-one: ``c >= ring + 1`` instead of
+      ``c >= ring``), so a slot's output buffer is rewritten while its
+      store is still draining;
+    - ``prologue_fill`` overrides the ``ring - 1`` prologue load count;
+    - ``skip_final_waits`` drops the epilogue store-waits (unpaired
+      copies at program end).
+    """
+    ring = int(ring)
+    nchunks = int(nchunks)
+    events: list[Event] = []
+    fill = ring - 1 if prologue_fill is None else int(prologue_fill)
+    # prologue: fill all but one ring slot
+    for j in range(min(fill, nchunks)):
+        events.append(("load_start", j, j))
+    for c in range(nchunks):
+        slot = c % ring
+        ahead = c + ring - 1
+        if ahead < nchunks:
+            # refill the slot chunk c-1's compute freed, ring-1 ahead
+            events.append(("load_start", ahead % ring, ahead))
+        events.append(("load_wait", slot, c))
+        events.append(("compute", slot, c))
+        if c >= ring + store_wait_offset:
+            # the store that used this slot ring chunks ago must drain
+            # before the slot's output buffer is overwritten
+            events.append(("store_wait", slot, c - ring))
+        events.append(("store_write", slot, c))
+        events.append(("store_start", slot, c))
+    if not skip_final_waits:
+        for c in range(max(0, nchunks - ring), nchunks):
+            events.append(("store_wait", c % ring, c))
+    return events
+
+
+def check_events(events: list[Event], nchunks: int, ring: int, *,
+                 location: str = "ring") -> list[Finding]:
+    """Simulate ``events`` over per-slot load/store state machines and
+    report every hazard (see module docstring for the invariant set).
+    An empty return is the hazard-freedom proof for that schedule."""
+    findings: list[Finding] = []
+    # slot -> (state, chunk); load states: inflight -> ready -> consumed
+    loads: dict[int, tuple[str, int]] = {}
+    # store states: written -> inflight -> drained
+    stores: dict[int, tuple[str, int]] = {}
+    computed: list[int] = []
+
+    def bad(code: str, msg: str) -> None:
+        findings.append(make_finding(code, msg, location))
+
+    for kind, slot, c in events:
+        if kind == "load_start":
+            st = loads.get(slot)
+            if st is not None and st[0] == "inflight":
+                bad("QT201", f"load of chunk {c} starts into slot {slot} "
+                             f"while chunk {st[1]}'s load is in flight")
+            elif st is not None and st[0] == "ready":
+                bad("QT201", f"load of chunk {c} overwrites slot {slot} "
+                             f"before chunk {st[1]} was computed (WAR)")
+            loads[slot] = ("inflight", c)
+        elif kind == "load_wait":
+            st = loads.get(slot)
+            if st is None or st[0] != "inflight" or st[1] != c:
+                bad("QT201", f"load-wait on (slot {slot}, chunk {c}) with "
+                             f"no matching in-flight load (state {st})")
+            else:
+                loads[slot] = ("ready", c)
+        elif kind == "compute":
+            st = loads.get(slot)
+            if st is None or st[0] != "ready" or st[1] != c:
+                bad("QT201", f"compute of chunk {c} reads slot {slot} "
+                             f"without a completed load (state {st}, RAW)")
+            else:
+                loads[slot] = ("consumed", c)
+            computed.append(c)
+        elif kind == "store_write":
+            st = stores.get(slot)
+            if st is not None and st[0] == "inflight":
+                bad("QT202", f"chunk {c} rewrites out-slot {slot} while "
+                             f"chunk {st[1]}'s store is draining (WAR)")
+            stores[slot] = ("written", c)
+        elif kind == "store_start":
+            st = stores.get(slot)
+            if st is None or st[0] != "written" or st[1] != c:
+                bad("QT202", f"store of chunk {c} starts from slot {slot} "
+                             f"that was not written for it (state {st})")
+            else:
+                stores[slot] = ("inflight", c)
+        elif kind == "store_wait":
+            st = stores.get(slot)
+            if st is None or st[0] != "inflight" or st[1] != c:
+                bad("QT202", f"store-wait on (slot {slot}, chunk {c}) "
+                             f"with no matching in-flight store "
+                             f"(state {st})")
+            else:
+                stores[slot] = ("drained", c)
+        else:  # pragma: no cover - generator emits only the kinds above
+            bad("QT201", f"unknown ring event kind {kind!r}")
+
+    for slot, st in sorted(loads.items()):
+        if st[0] == "inflight":
+            bad("QT201", f"load of chunk {st[1]} (slot {slot}) never "
+                         f"waited (unpaired copy at program end)")
+    for slot, st in sorted(stores.items()):
+        if st[0] in ("written", "inflight"):
+            bad("QT202", f"store of chunk {st[1]} (slot {slot}) never "
+                         f"drained (unpaired copy at program end)")
+    if computed != list(range(nchunks)):
+        bad("QT201", f"chunks computed out of order or missing: "
+                     f"{computed[:8]}... expected 0..{nchunks - 1}")
+    return findings
+
+
+def check_ring(nchunks: int, ring_depth: int, slot_bytes: int, *,
+               budget: Optional[int] = None,
+               location: str = "ring",
+               max_sim_chunks: int = 256) -> list[Finding]:
+    """Full check of one ring operating point: resolve the effective
+    depth through the caller's clamp/derate
+    (:func:`..ops.pallas_gates.effective_ring_depth`), prove VMEM-budget
+    compliance, and simulate the pipeline's event schedule for hazards.
+
+    Long sweeps are simulated at a capped chunk count
+    (``max_sim_chunks``, >= several ring periods): the pipeline is
+    periodic in ``ring``, so a steady-state prefix plus the epilogue
+    covers every distinct (slot, chunk-phase) interaction."""
+    from ..ops.pallas_gates import _RING_VMEM_BUDGET, effective_ring_depth
+
+    budget_b = _RING_VMEM_BUDGET if budget is None else int(budget)
+    findings: list[Finding] = []
+    ring = effective_ring_depth(ring_depth, nchunks, slot_bytes,
+                                budget=budget_b)
+    requested = int(ring_depth)
+    if ring != max(2, requested):
+        findings.append(make_finding(
+            "QT204",
+            f"requested ring depth {requested} runs at {ring} "
+            f"(chunks={nchunks}, slot_bytes={slot_bytes}, "
+            f"budget={budget_b})",
+            location))
+    if 2 * ring * slot_bytes > budget_b:
+        findings.append(make_finding(
+            "QT203",
+            f"ring buffers need {2 * ring * slot_bytes} bytes at the "
+            f"minimum depth {ring}, over the {budget_b}-byte budget",
+            location))
+    sim_chunks = min(int(nchunks), max(int(max_sim_chunks), 4 * ring + 4))
+    sim_ring = max(2, min(ring, sim_chunks))
+    findings.extend(check_events(ring_events(sim_chunks, sim_ring),
+                                 sim_chunks, sim_ring,
+                                 location=f"{location}"
+                                          f"(chunks={nchunks},"
+                                          f"ring={ring})"))
+    return findings
+
+
+def sweep_reachable(*, rings: tuple = (2, 3, 4, 5),
+                    chunk_counts: tuple = (2, 3, 4, 5, 8, 16, 64, 128),
+                    geometries: Optional[tuple] = None) -> list[Finding]:
+    """The cross-product proof the tentpole asks for: every ring depth
+    {2..5} x chunk count x reachable tile geometry (incl. the df 4-plane
+    layout) is clamp-resolved, budget-checked and hazard-simulated.
+    Returns the concatenated findings (errors empty = proof holds)."""
+    from ..ops.pallas_gates import _LANES
+
+    geos = REACHABLE_GEOMETRIES if geometries is None else geometries
+    findings: list[Finding] = []
+    for label, planes, s, itemsize in geos:
+        slot_bytes = planes * s * _LANES * itemsize
+        for ring in rings:
+            for nchunks in chunk_counts:
+                findings.extend(check_ring(
+                    nchunks, ring, slot_bytes,
+                    location=f"sweep[{label},s={s},ring={ring},"
+                             f"chunks={nchunks}]"))
+    return findings

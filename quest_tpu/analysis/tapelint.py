@@ -1,0 +1,215 @@
+"""Tape linter: circuit-level advice and apply-time traps (QT0xx).
+
+Walks a recorded ``Circuit`` tape through the fuser's own spy-capture
+(:func:`..fusion.capture`), so what is linted is exactly what the planner
+sees -- GateEvents in primitive form, with API sugar and density shadows
+resolved. Four lints:
+
+- **QT001** adjacent self-inverse pairs: two events with the same
+  support composing to the identity (up to global phase), separated only
+  by support-disjoint events -- both gates are dead weight.
+- **QT002** mergeable same-axis rotations: two tape entries of the same
+  rotation/phase-family function with identical structure (targets,
+  controls, axes) separated only by support-disjoint entries -- one
+  rotation of the summed angle does the same work in half the passes.
+- **QT003** constant angles at liftable positions: every anonymous slot
+  :func:`..engine.params.lift_tape` would create is a parameter the
+  circuit could have recorded as ``engine.P(...)``; as plain constants
+  they bake into the structure fingerprint, so structure-equal circuits
+  compile separate executables instead of sharing one
+  (docs/serving.md). Cross-checked against ``lift_tape`` itself: the
+  reported count IS the lifted tape's anonymous-slot count.
+- **QT004** control/target overlap in a captured event: the runtime
+  validators only see this at apply time; the linter sees it at record
+  time. Also exposed standalone as :func:`lint_events` for synthetic /
+  kernel-level event streams.
+
+Entries the spy cannot capture (operator entries, Param-carrying
+entries, inits) act as lint barriers, exactly as they act as fusion
+barriers -- nothing is matched across them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .diagnostics import Finding, make_finding
+
+__all__ = ["lint_events", "lint_tape", "lint_circuit"]
+
+_TOL = 1e-9
+
+
+def lint_events(events, location: str = "events") -> list[Finding]:
+    """QT004 over a GateEvent stream: control/target aliasing and
+    duplicate targets, per event."""
+    findings: list[Finding] = []
+    for i, ev in enumerate(events):
+        where = f"{location}[{i}]:{ev.kind}"
+        if ev.kind in ("aux",):
+            continue
+        ts = tuple(ev.targets)
+        if len(set(ts)) != len(ts):
+            findings.append(make_finding(
+                "QT004", f"{ev.kind} event repeats a target in {ts}",
+                where))
+        overlap = sorted(set(ts) & set(ev.controls))
+        if overlap:
+            findings.append(make_finding(
+                "QT004",
+                f"{ev.kind} event uses qubit(s) {overlap} as both "
+                f"target and control", where))
+    return findings
+
+
+def _events_cancel(a, b) -> bool:
+    """True when events ``a`` then ``b`` compose to the identity (up to
+    global phase). Conservative: False on anything uncertain."""
+    if (a.kind != b.kind or tuple(a.targets) != tuple(b.targets)
+            or tuple(a.controls) != tuple(b.controls)
+            or tuple(a.states) != tuple(b.states)):
+        if a.kind == b.kind == "swap" and not a.controls and not b.controls:
+            return set(a.targets) == set(b.targets)
+        return False
+    if a.kind == "x":
+        return True
+    if a.kind == "swap":
+        return True
+    if a.kind == "parity":
+        return abs(a.theta + b.theta) < _TOL
+    if a.kind == "matrix" and a.matrix is not None and b.matrix is not None:
+        if a.matrix.shape != b.matrix.shape:
+            return False
+        prod = np.asarray(b.matrix) @ np.asarray(a.matrix)
+        c = prod[0, 0]
+        return (abs(abs(c) - 1.0) < 1e-7
+                and np.allclose(prod, c * np.eye(prod.shape[0]),
+                                atol=1e-7))
+    if a.kind == "diag" and a.diag is not None and b.diag is not None:
+        if a.diag.shape != b.diag.shape:
+            return False
+        return np.allclose(np.asarray(a.diag) * np.asarray(b.diag), 1.0,
+                           atol=1e-7)
+    return False
+
+
+def _structure_key(name: str, args, kwargs) -> tuple:
+    """A tape entry with its liftable value positions masked out -- two
+    entries with the same key differ only in angles."""
+    from ..engine.params import _LIFTABLE, is_value
+
+    spec = _LIFTABLE.get(name, {})
+    masked_args = tuple(
+        "<value>" if spec.get(i) is not None and is_value(v) else _freeze(v)
+        for i, v in enumerate(args))
+    masked_kwargs = tuple(sorted(
+        (k, "<value>" if spec.get(k) is not None and is_value(v)
+         else _freeze(v))
+        for k, v in kwargs.items()))
+    return (name, masked_args, masked_kwargs)
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, np.ndarray):
+        return ("<array>", v.shape)
+    return v
+
+
+def lint_tape(tape, num_qubits: int, *, is_density: bool = False,
+              dtype=None, location: str = "tape") -> list[Finding]:
+    """Lint a recorded tape (list of ``(fn, args, kwargs)`` entries); see
+    the module docstring for the four lint classes."""
+    from ..engine.params import _LIFTABLE, lift_slot_census
+    from ..fusion import capture
+    from ..precision import real_dtype
+    from ..validation import QuESTError
+
+    dt = np.dtype(dtype) if dtype is not None else real_dtype(None)
+    findings: list[Finding] = []
+
+    # event-level window since the last barrier, for QT001/QT004
+    live_events: list[tuple] = []   # (entry_idx, GateEvent)
+    # entry-level window for QT002
+    live_entries: list[tuple] = []  # (entry_idx, structure_key, support)
+
+    for idx, (fn, args, kwargs) in enumerate(tape):
+        name = getattr(fn, "__name__", "")
+        where = f"{location}[{idx}]:{name}"
+        events = capture(fn, args, kwargs, num_qubits, dt,
+                         is_density=is_density)
+        if events is None:
+            live_events.clear()
+            live_entries.clear()
+            continue
+        findings.extend(lint_events(events, location=where))
+        support = frozenset().union(*(ev.support for ev in events)) \
+            if events else frozenset()
+
+        # QT001: scan back over support-disjoint events for an inverse
+        for ev in events:
+            matched = None
+            for j in range(len(live_events) - 1, -1, -1):
+                pidx, pev = live_events[j]
+                if not (pev.support & ev.support):
+                    continue
+                if _events_cancel(pev, ev):
+                    matched = (j, pidx)
+                break  # first support-overlapping event decides
+            if matched is not None:
+                j, pidx = matched
+                findings.append(make_finding(
+                    "QT001",
+                    f"cancels the {live_events[j][1].kind} gate of "
+                    f"entry [{pidx}] on qubits "
+                    f"{sorted(ev.support)}", where))
+                del live_events[j]
+            else:
+                live_events.append((idx, ev))
+
+        # QT002: same-structure rotation-family entries
+        if name in _LIFTABLE and len(events) >= 1:
+            key = _structure_key(name, args, kwargs)
+            for j in range(len(live_entries) - 1, -1, -1):
+                pidx, pkey, psupport = live_entries[j]
+                if not (psupport & support):
+                    continue
+                if pkey == key:
+                    findings.append(make_finding(
+                        "QT002",
+                        f"same-axis {name} as entry [{pidx}] on qubits "
+                        f"{sorted(support)}; the two angles sum", where))
+                break
+            live_entries.append((idx, key, support))
+        elif support:
+            # a non-rotation entry on these qubits blocks merging across
+            live_entries.append((idx, None, support))
+
+    # QT003: aggregate param-lift candidacy -- the count comes from
+    # lift_tape itself (engine.params.lift_slot_census), so the lint and
+    # the serving engine agree by construction
+    try:
+        anon, named = lift_slot_census(tape)
+    except QuESTError:
+        anon = 0
+    if anon:
+        findings.append(make_finding(
+            "QT003",
+            f"{anon} constant angle(s)/scalar(s) at liftable "
+            f"positions ({named} already Params): structure-equal "
+            f"variants of this circuit will not share a compiled "
+            f"executable", f"{location}.params"))
+    return findings
+
+
+def lint_circuit(circuit, *, location: Optional[str] = None
+                 ) -> list[Finding]:
+    """:func:`lint_tape` over a :class:`..circuits.Circuit`."""
+    loc = location if location is not None else \
+        f"circuit({circuit.num_qubits}q)"
+    return lint_tape(list(circuit._tape), circuit.num_qubits,
+                     is_density=circuit.is_density_matrix,
+                     location=loc)

@@ -513,6 +513,18 @@ class Circuit:
             for item in p.items:
                 if isinstance(item, fusion.PallasRun):
                     item.ring_depth = int(ring_depth)
+        from . import analysis
+        if analysis.verify_enabled():
+            # QUEST_VERIFY=1: statically verify the plan's frame/ring
+            # invariants at compile time; raises AnalysisError on
+            # error-severity findings (docs/analysis.md). Sharded plans
+            # are verified over the FULL state-vector space: frame grid
+            # blocks may reach sharded qubits (collective transposes).
+            plan_space = \
+                (2 if self.is_density_matrix else 1) * self.num_qubits
+            analysis.verify_plan(
+                p, nsv=plan_space, dtype=dt, shard_qubits=shard_boundary,
+                location=f"fused({self.num_qubits}q)")
         out = Circuit(self.num_qubits, self.is_density_matrix)
         out._tape = fusion.as_tape(p)
         return out

@@ -39,8 +39,8 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["Param", "P", "LiftedTape", "Slot", "ParamExecutable",
-           "lift_tape", "bind", "materialize_entry", "materialize_tape",
-           "has_params", "is_value"]
+           "lift_tape", "lift_slot_census", "bind", "materialize_entry",
+           "materialize_tape", "has_params", "is_value"]
 
 
 class Param:
@@ -222,6 +222,18 @@ def lift_tape(tape) -> LiftedTape:
                 new_kwargs[k] = v
         entries.append((fn, tuple(new_args), new_kwargs))
     return LiftedTape(tuple(entries), tuple(slots))
+
+
+def lift_slot_census(tape) -> tuple[int, int]:
+    """``(anonymous, named)`` slot counts of ``lift_tape(tape)``: how many
+    liftable positions carry constants vs ``Param`` placeholders. Anonymous
+    slots are the executable-cache hazard -- structure-equal circuits that
+    differ only in those constants cannot share a compiled program
+    (engine/cache.structure_fingerprint bakes them) -- and the count is
+    what the tape linter reports as QT003 (quest_tpu/analysis)."""
+    slots = lift_tape(tuple(tape)).slots
+    anon = sum(1 for s in slots if s.name is None)
+    return anon, len(slots) - anon
 
 
 def bind(lifted: LiftedTape, params=None, device: bool = True) -> tuple:

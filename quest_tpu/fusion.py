@@ -1053,25 +1053,45 @@ def transpose_stats(p: FusePlan, shard_qubits: int | None,
     return out
 
 
+def plan_from_tape(tape) -> FusePlan:
+    """Decode an ``as_tape`` tape back into a :class:`FusePlan` -- the
+    ONE decoder of the `_apply_pallas_run` / `_apply_frame_swap` /
+    `_apply_dense_block` / `_apply_gate_diag` tape-entry layouts
+    (:func:`as_tape` is the encoder). Entries that aren't plan items pass
+    through verbatim as ``(fn, args, kwargs)`` tuples, so
+    ``plan_from_tape(as_tape(p))`` round-trips. Used by the bench
+    artifacts, the driver dryrun and the static plan verifier
+    (analysis.plancheck), which see executed circuits, not plans."""
+    p = FusePlan()
+    for entry in tape:
+        f, a, _kw = entry
+        name = getattr(f, "__name__", "")
+        if name == "_apply_pallas_run":
+            ops, tb, lk, sk, lh, sh = a[:6]
+            rd = a[6] if len(a) > 6 else None
+            p.items.append(PallasRun(tuple(ops), tb, load_swap_k=lk,
+                                     store_swap_k=sk, load_swap_hi=lh,
+                                     store_swap_hi=sh, ring_depth=rd))
+        elif name == "_apply_frame_swap":
+            tb, k, hi = a
+            p.items.append(FrameSwap(tb, k, hi))
+        elif name == "_apply_dense_block":
+            p.items.append(FusedBlock(tuple(a[1]), a[0]))
+        elif name == "_apply_gate_diag":
+            p.items.append(DiagBlock(tuple(a[1]), a[0]))
+        else:
+            p.items.append(entry)
+    return p
+
+
 def tape_transpose_stats(tape, shard_qubits: int | None,
                          nsv: int | None = None,
                          num_slices: int = 1) -> dict:
     """:func:`transpose_stats` over an ``as_tape`` tape instead of a
-    FusePlan -- the ONE decoder of the `_apply_pallas_run` /
-    `_apply_frame_swap` tape-entry layouts (used by the bench artifacts
-    and the driver dryrun, which see executed circuits, not plans)."""
-    p = FusePlan()
-    for f, a, _ in tape:
-        name = getattr(f, "__name__", "")
-        if name == "_apply_pallas_run":
-            ops, tb, lk, sk, lh, sh = a[:6]  # a[6] (ring depth, optional)
-            p.items.append(PallasRun(tuple(ops), tb, load_swap_k=lk,
-                                     store_swap_k=sk, load_swap_hi=lh,
-                                     store_swap_hi=sh))
-        elif name == "_apply_frame_swap":
-            tb, k, hi = a
-            p.items.append(FrameSwap(tb, k, hi))
-    return transpose_stats(p, shard_qubits, nsv=nsv, num_slices=num_slices)
+    FusePlan (used by the bench artifacts and the driver dryrun, which
+    see executed circuits, not plans)."""
+    return transpose_stats(plan_from_tape(tape), shard_qubits, nsv=nsv,
+                           num_slices=num_slices)
 
 
 def plan_pallas_sharded(tape, num_qubits: int, dtype, max_qubits: int,

@@ -108,16 +108,66 @@ _RING_ENV = "QUEST_PALLAS_RING"
 _RING_VMEM_BUDGET = 48 * 1024 * 1024
 
 
+#: raw QUEST_PALLAS_RING values already diagnosed (QT205 warns once per
+#: distinct value, not once per kernel launch)
+_RING_ENV_WARNED: set = set()
+
+
+def _ring_env_diagnostic(raw: str, used: int, why: str) -> None:
+    """Flight-record a QT205 diagnostic for a malformed/out-of-range
+    QUEST_PALLAS_RING value (once per distinct raw value): the silent
+    coercion stays -- the kernel must still launch -- but the clamped
+    value is stated via telemetry and a RuntimeWarning."""
+    if raw in _RING_ENV_WARNED:
+        return
+    _RING_ENV_WARNED.add(raw)
+    import warnings
+
+    # deliberate late import: diagnostics depends only on telemetry, so
+    # this cannot cycle back into the ops layer
+    from ..analysis.diagnostics import emit_findings, make_finding
+
+    f = make_finding(
+        "QT205",
+        f"{_RING_ENV}={raw!r} {why}; running with ring depth {used}",
+        f"env:{_RING_ENV}")
+    emit_findings([f])
+    warnings.warn(str(f), RuntimeWarning, stacklevel=3)
+
+
 def ring_depth_default() -> int:
     """The process-wide DMA ring depth: QUEST_PALLAS_RING if set (min 2),
-    else _DEF_RING_DEPTH."""
+    else _DEF_RING_DEPTH. Malformed or sub-minimum values are coerced as
+    before, but now leave a QT205 diagnostic (warn-once telemetry record
+    stating the clamped value) instead of being swallowed silently."""
     raw = os.environ.get(_RING_ENV, "").strip()
     if raw:
         try:
-            return max(2, int(raw))
+            v = int(raw)
         except ValueError:
-            pass
+            _ring_env_diagnostic(raw, _DEF_RING_DEPTH,
+                                 "is not an integer")
+            return _DEF_RING_DEPTH
+        if v < 2:
+            _ring_env_diagnostic(raw, 2,
+                                 "is below the 2-slot ring minimum")
+            return 2
+        return v
     return _DEF_RING_DEPTH
+
+
+def effective_ring_depth(ring_depth: int, nchunks: int, slot_bytes: int,
+                         budget: int = _RING_VMEM_BUDGET) -> int:
+    """The ring depth a grid kernel actually runs: the requested depth
+    clamped to [2, nchunks], then derated one slot at a time while the
+    in+out ring buffers (2 * ring * slot_bytes) overflow ``budget``.
+    The ONE clamp shared by the kernel caller (_fused_local_run) and the
+    static ring checker (analysis.ringcheck), so the checker verifies
+    the operating point the kernel really uses."""
+    ring = max(2, min(int(ring_depth), int(nchunks)))
+    while ring > 2 and 2 * ring * slot_bytes > budget:
+        ring -= 1
+    return ring
 
 
 #: matmul precision for the in-kernel zone dots (lane_u / window). Mosaic
@@ -1196,9 +1246,7 @@ def _fused_local_run(amps, shard_index, *, n: int, ops: tuple, sublanes: int,
         # buffers (in + out) fit the VMEM budget -- depth must never turn a
         # compiling kernel into a Mosaic OOM
         slot_bytes = P * s * _LANES * np.dtype(amps.dtype).itemsize
-        ring = max(2, min(int(ring_depth), grid))
-        while ring > 2 and 2 * ring * slot_bytes > _RING_VMEM_BUDGET:
-            ring -= 1
+        ring = effective_ring_depth(ring_depth, grid, slot_bytes)
         kernel = _make_dma_kernel(tuple(ops_r), s, tile_bits,
                                   np.dtype(amps.dtype), grid, lsw, ssw,
                                   df=df, ring=ring, local_n=local_n,

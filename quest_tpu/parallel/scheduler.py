@@ -124,6 +124,21 @@ class DistributedScheduler:
         "frame_transpose_chunks": 0.0,
         "frame_transpose_planar_chunks": 0.0,
         "ici_chunks": 0.0, "dcn_chunks": 0.0})
+    #: optional decision journal for the static plan verifier
+    #: (analysis.plancheck.check_schedule): when set to a list, every
+    #: communication decision appends one record --
+    #:   ("pair_exchange", n, target) | ("rank_permute", n, qubit)
+    #:   | ("dist_swap", n, a, b, layout_tracked)
+    #:   | ("virtual_swap", p1, p2) | ("reconcile_swap", n, a, b)
+    #:   | ("permute", n, source, unit_scale, kind)
+    #:   | ("reconcile_done", n)
+    #: -- enough to re-price the whole plan and replay the layout
+    #: independently. None (the default) records nothing.
+    journal: list | None = None
+
+    def _note(self, *rec) -> None:
+        if self.journal is not None:
+            self.journal.append(rec)
 
     def _count_comm(self, n: int, qubit: int, chunks: float,
                     kind: str = "other") -> None:
@@ -296,8 +311,10 @@ class DistributedScheduler:
                                      kind="reconciliation")
                 else:
                     self.stats["local"] += 1
+                self._note("reconcile_swap", n, a, b)
                 amps = X.dist_swap(amps, n=n, qb1=a, qb2=b, mesh=self.mesh)
                 self._swap_positions(a, b)
+            self._note("reconcile_done", n)
             return amps
         self.stats["reconcile_collectives"] += cstats["collectives"]
         self.stats["reconcile_chunks"] += cstats["chunk_units"]
@@ -318,9 +335,11 @@ class DistributedScheduler:
             for q in moved:
                 self._count_comm(n, q, 2.0 / len(moved),
                                  kind="reconciliation")
+        self._note("permute", n, source, 1.0, "reconciliation")
         amps = X.dist_permute_bits(amps, n=n, source=source, mesh=self.mesh)
         self._pos = list(range(n))
         self._occ = list(range(n))
+        self._note("reconcile_done", n)
         return amps
 
     def apply_frame_permute(self, amps, *, n, lo1, lo2, k):
@@ -359,6 +378,7 @@ class DistributedScheduler:
             for q in moved:
                 self._count_comm(n, q, 2.0 * scale / len(moved),
                                  kind="frame_transpose")
+        self._note("permute", n, source, scale, "frame_transpose")
         return X.dist_permute_bits(amps, n=n, source=source, mesh=self.mesh)
 
     def _pending_shard_uses(self, n, nl, exclude, capacity) -> list:
@@ -497,6 +517,8 @@ class DistributedScheduler:
                 share = cstats["chunk_units"] / len(pairs)
                 for s, _ in pairs:
                     self._count_comm(n, s, share, kind="relocation_batch")
+                self._note("permute", n, tuple(source), 1.0,
+                           "relocation_batch")
                 amps = X.dist_permute_bits(amps, n=n, source=tuple(source),
                                            mesh=self.mesh)
                 for s, f in pairs:
@@ -506,6 +528,7 @@ class DistributedScheduler:
         for s, f in zip(shard, free):
             self.stats["relocation_swaps"] += 1
             self._count_comm(n, s, 1.0, kind="dist_swap")
+            self._note("dist_swap", n, f, s, self.deferring)
             amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh)
             if self.deferring:
                 self._swap_positions(f, s)
@@ -542,6 +565,7 @@ class DistributedScheduler:
                 self.stats["pair_exchanges"] += 1
                 self._count_comm(n, p_targets[0], 2.0,
                                  kind="pair_exchange")
+                self._note("pair_exchange", n, p_targets[0])
                 return X.dist_apply_matrix1(
                     amps, matrix, n=n, target=p_targets[0],
                     controls=p_controls,
@@ -568,6 +592,7 @@ class DistributedScheduler:
             for s, f in relocation.items():
                 self.stats["relocation_swaps"] += 1
                 self._count_comm(n, s, 1.0, kind="dist_swap")
+                self._note("dist_swap", n, f, s, False)
                 amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh)
         return amps
 
@@ -601,6 +626,8 @@ class DistributedScheduler:
             self.stats["rank_permutes"] += 1
             self._count_comm(n, max(t for t in p_targets if t >= nl), 2.0,
                              kind="grouped_permute")
+            self._note("rank_permute", n,
+                       max(t for t in p_targets if t >= nl))
         return X.dist_apply_x(amps, n=n, targets=p_targets,
                               controls=p_controls,
                               control_states=tuple(control_states),
@@ -616,6 +643,7 @@ class DistributedScheduler:
             p1, p2 = self._pos[qb1], self._pos[qb2]
             self._swap_positions(p1, p2)
             self.stats["virtual_swaps"] += 1
+            self._note("virtual_swap", p1, p2)
             telemetry.inc("comm_ops_total", kind="virtual_swap")
             return amps
         p1, p2 = self._map(n, (qb1, qb2))
@@ -626,9 +654,11 @@ class DistributedScheduler:
         elif min(p1, p2) >= nl:
             self.stats["rank_permutes"] += 1
             self._count_comm(n, max(p1, p2), 2.0, kind="grouped_permute")
+            self._note("rank_permute", n, max(p1, p2))
         else:
             self.stats["relocation_swaps"] += 1
             self._count_comm(n, max(p1, p2), 1.0, kind="dist_swap")
+            self._note("dist_swap", n, p1, p2, False)
         return X.dist_swap(amps, n=n, qb1=p1, qb2=p2, mesh=self.mesh)
 
     # -- diagonal family (always comm-free) ---------------------------------
@@ -714,13 +744,16 @@ def comm_chunks(stats: dict) -> float:
 
 def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
                  defer: bool = True, collective_reconcile: bool = True,
-                 batch_relocations: bool = True, dtype=None):
+                 batch_relocations: bool = True, dtype=None,
+                 journal: list | None = None):
     """Trace ``circuit`` abstractly under the explicit scheduler and return
     its communication plan stats (no device execution -- jax.eval_shape).
     ``dtype`` sets the abstract register's amplitude dtype (default: the
     process precision) -- an f64 plan whose fused tape takes the sharded
     double-float route prices its frame transposes at the df 2x chunk-unit
-    scale, exactly as the executed replay counts them."""
+    scale, exactly as the executed replay counts them. ``journal`` (a
+    caller-owned list) additionally records every communication decision
+    for the static verifier (see DistributedScheduler.journal)."""
     import jax
     import numpy as np
 
@@ -736,6 +769,8 @@ def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
     with explicit_mesh(mesh, num_slices=num_slices, defer=defer,
                        collective_reconcile=collective_reconcile,
                        batch_relocations=batch_relocations) as sched:
+        if sched is not None and journal is not None:
+            sched.journal = journal
         fn = circuit.as_fn()
         jax.eval_shape(fn, jax.ShapeDtypeStruct((2, num_amps), dt))
     if sched is None:

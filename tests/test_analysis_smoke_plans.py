@@ -1,0 +1,41 @@
+"""Tier-1 analysis gate: the plan verifier must pass every bench --smoke
+plan config with ZERO error-severity findings (ISSUE 6 satellite).
+
+One test per ``bench.smoke_plan_specs()`` row -- the same specs
+``tools/lint.py --bench-plans`` (and the CI bench-smoke lint gate) runs:
+
+- plan_20q_relocation: tape lint + comm-schedule re-pricing on the
+  8-way abstract mesh (deferred relocations, batched collectives);
+- plan_20q_f64: the sharded double-float fused plan -- frame/ring check
+  over the FULL 20q space at df 4-plane geometry plus the df-scaled
+  (plane_unit_scale 2x) schedule re-pricing;
+- serve_20q: the fully parameterized serving ansatz's fused plan.
+
+Everything is static (abstract mesh, no state execution), so the gate
+costs planning time only.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import analysis as A
+
+import bench
+
+SPECS = {s["name"]: s for s in bench.smoke_plan_specs()}
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_smoke_plan_has_zero_error_findings(name, monkeypatch):
+    spec = SPECS[name]
+    if spec.get("dtype") == np.float64:
+        if np.dtype(qt.precision.real_dtype()) != np.dtype("float64"):
+            pytest.skip("f64 smoke leg needs QUEST_PRECISION=2 (the "
+                        "conftest default)")
+        # plan at the double-float geometry, as bench's re-execed
+        # PRECISION=2 process does on CPU
+        monkeypatch.setenv("QUEST_PALLAS_DF", "1")
+    findings = A.check_smoke_spec(spec)
+    errors = A.error_findings(findings)
+    assert not errors, A.render_text(errors)

@@ -869,8 +869,11 @@ def test_ring_depth_knobs():
 
     with mock.patch.dict(os.environ, {"QUEST_PALLAS_RING": "4"}):
         assert PG.ring_depth_default() == 4
-    with mock.patch.dict(os.environ, {"QUEST_PALLAS_RING": "1"}):
-        assert PG.ring_depth_default() == 2  # clamped to the minimum
+    with mock.patch.dict(os.environ, {"QUEST_PALLAS_RING": "1"}), \
+            mock.patch.object(PG, "_RING_ENV_WARNED", set()), \
+            pytest.warns(RuntimeWarning, match="QT205"):
+        # out-of-range values clamp AND surface the QT205 diagnostic
+        assert PG.ring_depth_default() == 2
     with mock.patch.dict(os.environ, {}, clear=False):
         os.environ.pop("QUEST_PALLAS_RING", None)
         assert PG.ring_depth_default() == PG._DEF_RING_DEPTH

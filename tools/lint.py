@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Static-analysis CLI: run the plan verifier / ring checker / tape
+linter (quest_tpu.analysis, docs/analysis.md) from the command line.
+
+Three targets, one finding stream:
+
+  python tools/lint.py --bench-plans [--format json]
+      Verify every bench.py --smoke plan config (plan_20q_relocation,
+      plan_20q_f64, serve_20q): tape lint, frame/ring plan check and
+      comm-schedule re-pricing per spec (bench.smoke_plan_specs is the
+      config source). This is what the CI bench-smoke gate runs.
+
+  python tools/lint.py --qasm circuit.qasm
+      Lint an OPENQASM 2 file (the common gate subset; unknown gates
+      are skipped with a note on stderr) and statically check its fused
+      Pallas plan.
+
+  python tools/lint.py --module mymod:make_circuit
+      Lint a Circuit from python: ``attr`` may be a Circuit, a callable
+      returning one (or a list of them), or omitted -- then every
+      module-level Circuit is linted.
+
+Exit status 1 when any error-severity finding is reported (the CI gate
+contract); warnings/info exit 0. ``--format json`` prints the
+machine-readable ``{"findings": [...], "summary": {...}}`` shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import re
+import sys
+
+
+def _bootstrap_env(bench_plans: bool) -> None:
+    """Process knobs must be set before jax/quest_tpu import: CPU is fine
+    for every static check, and the f64 smoke leg (plan_20q_f64) needs a
+    PRECISION=2 process with the df route enabled, exactly as
+    ``bench.py main()`` re-execs itself."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if bench_plans:
+        os.environ.setdefault("QUEST_PRECISION", "2")
+        os.environ.setdefault("QUEST_PALLAS_DF", "1")
+
+
+#: OPENQASM 2 gates the reader maps onto the quest_tpu Circuit API:
+#: name -> (circuit method, qubit arity, angle arity)
+_QASM_GATES = {
+    "h": ("hadamard", 1, 0), "x": ("pauliX", 1, 0),
+    "y": ("pauliY", 1, 0), "z": ("pauliZ", 1, 0),
+    "s": ("sGate", 1, 0), "t": ("tGate", 1, 0),
+    "rx": ("rotateX", 1, 1), "ry": ("rotateY", 1, 1),
+    "rz": ("rotateZ", 1, 1), "u1": ("phaseShift", 1, 1),
+    "p": ("phaseShift", 1, 1),
+    "cx": ("controlledNot", 2, 0), "cz": ("controlledPhaseFlip", 2, 0),
+    "cp": ("controlledPhaseShift", 2, 1),
+    "cu1": ("controlledPhaseShift", 2, 1),
+    "crz": ("controlledRotateZ", 2, 1),
+    "swap": ("swapGate", 2, 0),
+}
+_SDG_TDG = {"sdg": -math.pi / 2, "tdg": -math.pi / 4}
+
+
+def _eval_angle(expr: str) -> float:
+    """Evaluate a QASM angle expression (numbers, pi, + - * /)."""
+    if not re.fullmatch(r"[\d.eE+\-*/() ]*(pi)?[\d.eE+\-*/() pi]*", expr):
+        raise ValueError(f"unsupported angle expression {expr!r}")
+    return float(eval(expr, {"__builtins__": {}}, {"pi": math.pi}))
+
+
+def read_qasm(path: str):
+    """A minimal OPENQASM 2 reader for the lint CLI: single qreg, the
+    `_QASM_GATES` subset; measure/barrier/creg/include are ignored,
+    anything else is reported on stderr and skipped (a skipped gate only
+    narrows the lint, never breaks it). quest_tpu.qasm is writer-only
+    (QASMLogger), so the CLI carries its own reader."""
+    from quest_tpu.circuits import Circuit
+
+    text = open(path).read()
+    text = re.sub(r"//[^\n]*", "", text)
+    circ = None
+    skipped = set()
+    for stmt in (s.strip() for s in text.split(";")):
+        if not stmt:
+            continue
+        m = re.match(r"(\w+)\s*(\(([^)]*)\))?\s*(.*)", stmt, re.S)
+        if not m:
+            continue
+        name, _, angles, rest = m.groups()
+        if name in ("OPENQASM", "include", "creg", "measure", "barrier",
+                    "if", "reset"):
+            continue
+        if name == "qreg":
+            size = int(re.search(r"\[(\d+)\]", rest).group(1))
+            circ = Circuit(size)
+            continue
+        if circ is None:
+            raise ValueError(f"{path}: gate before qreg: {stmt!r}")
+        qubits = [int(q) for q in re.findall(r"\[(\d+)\]", rest)]
+        if name in _SDG_TDG and len(qubits) == 1:
+            circ.phaseShift(qubits[0], _SDG_TDG[name])
+            continue
+        spec = _QASM_GATES.get(name)
+        if spec is None or len(qubits) != spec[1]:
+            skipped.add(name)
+            continue
+        method, _nq, na = spec
+        args = list(qubits)
+        if na:
+            args += [_eval_angle(a.strip())
+                     for a in (angles or "0").split(",")[:na]]
+        getattr(circ, method)(*args)
+    if circ is None:
+        raise ValueError(f"{path}: no qreg declaration found")
+    if skipped:
+        print(f"# skipped unsupported qasm gates: {sorted(skipped)}",
+              file=sys.stderr)
+    return circ
+
+
+def _circuits_from_module(spec: str) -> list:
+    from quest_tpu.circuits import Circuit
+
+    modname, _, attr = spec.partition(":")
+    sys.path.insert(0, os.getcwd())
+    import importlib
+    mod = importlib.import_module(modname)
+    if attr:
+        obj = getattr(mod, attr)
+        if callable(obj) and not isinstance(obj, Circuit):
+            obj = obj()
+        objs = obj if isinstance(obj, (list, tuple)) else [obj]
+    else:
+        objs = [v for v in vars(mod).values() if isinstance(v, Circuit)]
+    out = []
+    for i, c in enumerate(objs):
+        if not isinstance(c, Circuit):
+            raise TypeError(f"{spec}[{i}] is {type(c).__name__}, "
+                            f"not a Circuit")
+        out.append(c)
+    if not out:
+        raise ValueError(f"no Circuits found in {spec}")
+    return out
+
+
+def _lint_circuit_fully(circ, name: str) -> list:
+    """Tape lint + fused-plan frame/ring check for one circuit."""
+    from quest_tpu import analysis as A
+
+    findings = A.lint_circuit(circ, location=f"{name}.tape")
+    try:
+        fz = circ.fused(max_qubits=5, pallas=True)
+        nsv = (2 if circ.is_density_matrix else 1) * circ.num_qubits
+        findings += A.check_tape(fz._tape, nsv, location=f"{name}.plan")
+    except Exception as e:  # lint must still report what it has
+        print(f"# plan check unavailable for {name}: {e}", file=sys.stderr)
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    tgt = ap.add_mutually_exclusive_group(required=True)
+    tgt.add_argument("--bench-plans", action="store_true",
+                     help="verify every bench.py --smoke plan config")
+    tgt.add_argument("--qasm", metavar="FILE",
+                     help="lint an OPENQASM 2 file")
+    tgt.add_argument("--module", metavar="MOD[:ATTR]",
+                     help="lint Circuit(s) from a python module")
+    args = ap.parse_args(argv)
+
+    _bootstrap_env(args.bench_plans)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from quest_tpu import analysis as A
+
+    findings = []
+    if args.bench_plans:
+        import bench
+        for spec in bench.smoke_plan_specs():
+            findings += A.check_smoke_spec(spec)
+    elif args.qasm:
+        findings = _lint_circuit_fully(read_qasm(args.qasm),
+                                       os.path.basename(args.qasm))
+    else:
+        for i, circ in enumerate(_circuits_from_module(args.module)):
+            findings += _lint_circuit_fully(
+                circ, f"{args.module}[{i}]")
+
+    print(A.render_json(findings) if args.format == "json"
+          else A.render_text(findings))
+    return 1 if A.error_findings(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
