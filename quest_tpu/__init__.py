@@ -53,10 +53,17 @@ from .calculations import *  # noqa: F401,F403
 from .decoherence import *  # noqa: F401,F403
 from .operators import *  # noqa: F401,F403
 from .reporting import *  # noqa: F401,F403
-from .checkpoint import saveQureg, loadQureg, writeStateToCSV  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    loadQureg, saveQureg, verify_snapshot, writeStateToCSV,
+)
 from . import profiling  # noqa: F401
 from . import telemetry  # noqa: F401
 from . import engine  # noqa: F401
 from .engine import Engine, P, Param  # noqa: F401
+from . import resilience  # noqa: F401
+from .resilience import (  # noqa: F401
+    QuESTBackpressureError, QuESTCancelledError, QuESTPreemptionError,
+    QuESTRetryError, QuESTTimeoutError, resume_segmented,
+)
 
 __version__ = "0.1.0"

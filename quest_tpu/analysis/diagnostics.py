@@ -7,7 +7,10 @@ reports :class:`Finding` records drawn from one code catalog:
 - ``QT1xx`` -- plan verification (FusePlan frames, scheduler journals,
   chunk-unit pricing),
 - ``QT2xx`` -- kernel/DMA-ring checks (slot hazards, VMEM budget, ring
-  configuration).
+  configuration),
+- ``QT3xx`` -- resilience/runtime hardening (multihost bring-up timeout,
+  fault-plan and env-knob hygiene, segmented execution and checkpoint
+  generations -- docs/resilience.md).
 
 Each finding carries a severity (``error`` | ``warning`` | ``info``), a
 human-readable location and a one-line fix hint. :func:`emit_findings`
@@ -96,6 +99,30 @@ CATALOG: dict[str, tuple[str, str, str]] = {
     "QT205": ("warning", "QUEST_PALLAS_RING is malformed or out of range",
               "set QUEST_PALLAS_RING to an integer >= 2 (the 2-slot "
               "minimum); the malformed value was replaced"),
+    # -- QT3xx: resilience (fault injection, retry, segmented runs) ---------
+    "QT301": ("error", "multi-host initialization timed out or failed "
+                       "against the coordinator",
+              "check the coordinator address and network reachability; "
+              "the message names the initialization_timeout that was "
+              "applied (QUEST_INIT_TIMEOUT_S / init(...) argument)"),
+    "QT302": ("warning", "malformed or unknown QUEST_FAULTS entry ignored",
+              "use site:kind:nth (nth a positive integer, optionally "
+              "'N+') with a site/kind from "
+              "quest_tpu.resilience.faultinject.SITES"),
+    "QT303": ("warning", "malformed resilience environment value replaced "
+                         "by its default",
+              "QUEST_RETRY_MAX / QUEST_RETRY_BASE_MS / "
+              "QUEST_RETRY_DEADLINE_MS / QUEST_ENGINE_QUEUE_MAX / "
+              "QUEST_INIT_TIMEOUT_S must be numeric"),
+    "QT304": ("error", "segmented execution misconfiguration",
+              "every_n_items and keep must be >= 1, and the tape must "
+              "return to the identity frame at its end (a Circuit.fused "
+              "plan always does)"),
+    "QT305": ("warning", "checkpoint generation failed verification "
+                         "during resume",
+              "the generation was skipped and resume fell back to an "
+              "older verified snapshot; investigate the named shard for "
+              "torn writes or corruption"),
 }
 
 

@@ -582,3 +582,16 @@ class Circuit:
         with fusion.pallas_mesh(_register_mesh(qureg)):
             qureg.put(self.compiled()(qureg.amps))
         return qureg
+
+    def run_segmented(self, target, *, checkpoint_dir: str,
+                      every_n_items: int = 1, keep: int = 2) -> Qureg:
+        """Run the tape in segments, checkpointing at frame-identity
+        boundaries so a preempted run resumes bit-identically from the
+        last *verified* snapshot (:func:`quest_tpu.resilience.segmented.
+        resume_segmented`). ``target`` is a Qureg or a QuESTEnv (a fresh
+        zero-state register is created). ``every_n_items`` spaces the
+        checkpoint cadence in tape items; ``keep`` bounds snapshot
+        generations retained on disk. See docs/resilience.md."""
+        from .resilience import segmented as _seg
+        return _seg.run_segmented(self, target, checkpoint_dir=checkpoint_dir,
+                                  every_n_items=every_n_items, keep=keep)

@@ -33,6 +33,27 @@ Telemetry (docs/observability.md): ``engine_requests_total``,
 gauge, ``engine_trace_total{kind=param_replay}`` (one increment per jit
 trace of the replay -- the retrace detector tests assert on).
 
+Failure semantics (ISSUE 7 -- request-level, like Orca-style serving):
+
+- **Deadlines**: ``submit(params, timeout=)`` sets a wall-clock deadline;
+  requests still queued past it resolve with
+  :class:`~quest_tpu.resilience.QuESTTimeoutError` instead of dispatching
+  (``engine_request_timeouts_total``).
+- **Backpressure**: the queue is bounded (``queue_max`` ctor arg /
+  ``QUEST_ENGINE_QUEUE_MAX`` env); a full queue raises
+  :class:`~quest_tpu.resilience.QuESTBackpressureError` at submit
+  (``engine_backpressure_total``) rather than growing unboundedly.
+- **Poisoned-batch bisection**: when a batched dispatch fails, the
+  batcher bisects the batch through the SAME padded executable
+  (``engine_bisections_total``) -- healthy requests complete with
+  bit-identical results (vmap lanes are independent), and each poisoned
+  request gets its own exception. The ``engine.request`` fault-injection
+  site (quest_tpu.resilience.faultinject) pins injected poison to a
+  request at submit time, which is how the isolation tests drive this.
+- **Typed cancellation**: ``close(drain=False)`` resolves still-queued
+  futures with :class:`~quest_tpu.resilience.QuESTCancelledError` --
+  a waiter blocked on ``result()`` always wakes with a typed error.
+
 Lifecycle: construct, optionally :meth:`warmup`, ``submit``/``run``, then
 :meth:`close` -- which drains the queue (every accepted future resolves)
 and joins the batcher thread. The engine is also a context manager.
@@ -41,16 +62,52 @@ and joins the batcher thread. The engine is also a context manager.
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
 from collections import deque
 from concurrent.futures import Future
 
 from .. import telemetry
+from ..resilience import faultinject as _faults
+from ..resilience.errors import (PoisonedRequestFault, QuESTBackpressureError,
+                                 QuESTCancelledError, QuESTTimeoutError)
 from . import cache as _cache
 from .params import bind
 
 __all__ = ["Engine"]
+
+
+class _Request:
+    """One queued parameter set: bound values, the caller's future, the
+    enqueue timestamp, an optional wall-clock deadline, and the injected
+    poison kind pinned at submit time (None on healthy requests)."""
+
+    __slots__ = ("values", "fut", "t0", "deadline", "poison")
+
+    def __init__(self, values: tuple, fut: Future, t0: float,
+                 deadline: float | None, poison: str | None):
+        self.values = values
+        self.fut = fut
+        self.t0 = t0
+        self.deadline = deadline
+        self.poison = poison
+
+
+def _env_queue_max() -> int:
+    """``QUEST_ENGINE_QUEUE_MAX`` (0/unset = unbounded); malformed values
+    fall back to unbounded with a QT303 diagnostic."""
+    raw = os.environ.get("QUEST_ENGINE_QUEUE_MAX", "").strip()
+    if not raw:
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        from ..analysis.diagnostics import emit_findings, make_finding
+        emit_findings([make_finding(
+            "QT303", f"QUEST_ENGINE_QUEUE_MAX={raw!r} is not numeric; "
+            "using the default", "engine.Engine")])
+        return 0
 
 
 class Engine:
@@ -67,7 +124,8 @@ class Engine:
 
     def __init__(self, circuit, env=None, *, precision_code: int | None = None,
                  max_batch: int = 8, max_delay_ms: float = 2.0,
-                 initial="zero", donate: bool = True):
+                 initial="zero", donate: bool = True,
+                 queue_max: int | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -78,6 +136,12 @@ class Engine:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_delay_ms < 0:
             raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        if queue_max is None:
+            queue_max = _env_queue_max()
+        if queue_max < 0:
+            raise ValueError(f"queue_max must be >= 0, got {queue_max}")
+        #: pending-queue bound; 0 = unbounded (the pre-ISSUE-7 behavior)
+        self.queue_max = int(queue_max)
         self.circuit = circuit
         self.env = env
         self.max_batch = int(max_batch)
@@ -133,17 +197,24 @@ class Engine:
         """Ordered Param names every submit must bind."""
         return self._lifted.param_names
 
-    def submit(self, params: dict | None = None) -> Future:
+    def submit(self, params: dict | None = None,
+               timeout: float | None = None) -> Future:
         """Queue one parameter set; returns a Future resolving to the final
-        planar (2, 2^nsv) amplitude array (a batch slice when coalesced)."""
-        return self.submit_many([params])[0]
+        planar (2, 2^nsv) amplitude array (a batch slice when coalesced).
+        ``timeout`` (seconds) sets a deadline: a request still queued when
+        it expires resolves with QuESTTimeoutError instead of running."""
+        return self.submit_many([params], timeout=timeout)[0]
 
-    def submit_many(self, params_list) -> list:
+    def submit_many(self, params_list, timeout: float | None = None) -> list:
         """Queue several parameter sets ATOMICALLY (single lock hold), so an
         idle engine coalesces them into one dispatch -- the deterministic
-        enqueue the bench and dryrun batching assertions rely on."""
+        enqueue the bench and dryrun batching assertions rely on. Raises
+        QuESTBackpressureError (accepting NONE of them) when the bounded
+        queue cannot take the whole list."""
         if not params_list:
             return []
+        if timeout is not None and timeout < 0:
+            raise ValueError(f"timeout must be >= 0, got {timeout}")
         if not self._open:
             raise RuntimeError("Engine is closed")
         values_list = [bind(self._lifted, p) for p in params_list]
@@ -151,10 +222,23 @@ class Engine:
         with self._cv:
             if not self._open:
                 raise RuntimeError("Engine is closed")
+            if self.queue_max and \
+                    len(self._q) + len(values_list) > self.queue_max:
+                telemetry.inc("engine_backpressure_total")
+                raise QuESTBackpressureError(
+                    f"engine queue full ({len(self._q)} pending, "
+                    f"queue_max={self.queue_max}): rejecting "
+                    f"{len(values_list)} request(s)", "Engine.submit")
             now = time.perf_counter()
+            deadline = None if timeout is None else now + timeout
             for values in values_list:
                 fut = Future()
-                self._q.append((values, fut, now))
+                # injected poison pins to the REQUEST here, at submit time,
+                # so the nth-visit counting stays deterministic no matter
+                # how the batcher later coalesces or bisects
+                poison = _faults.fire("engine.request") \
+                    if _faults.enabled() else None
+                self._q.append(_Request(values, fut, now, deadline, poison))
                 futs.append(fut)
             telemetry.inc("engine_requests_total", len(futs))
             telemetry.set_gauge("engine_queue_depth", len(self._q))
@@ -182,12 +266,21 @@ class Engine:
     def close(self, drain: bool = True) -> None:
         """Stop accepting work and join the batcher. ``drain=True``
         (default) dispatches everything still queued first; ``drain=False``
-        cancels pending futures instead (in-flight work still completes)."""
+        resolves pending futures with a typed QuESTCancelledError instead
+        (in-flight work still completes). Every accepted future resolves
+        either way -- a waiter blocked on ``result()`` always wakes."""
         with self._cv:
             if not drain:
                 while self._q:
-                    _, fut, _ = self._q.popleft()
-                    fut.cancel()
+                    req = self._q.popleft()
+                    if not req.fut.done():
+                        # a typed resolution, not Future.cancel(): cancel()
+                        # is a no-op on futures a waiter already holds in
+                        # RUNNING transitions elsewhere, and CancelledError
+                        # carries no context -- this names the drop
+                        req.fut.set_exception(QuESTCancelledError(
+                            "request dropped by Engine.close(drain=False) "
+                            "before dispatch", "Engine.close"))
             self._open = False
             self._cv.notify_all()
         if self._thread.is_alive():
@@ -263,9 +356,29 @@ class Engine:
                         break
                     self._cv.wait(remaining)
                 telemetry.set_gauge("engine_queue_depth", len(self._q))
-            self._dispatch(batch)
+            live = self._expire(batch)
+            if live:
+                self._dispatch(live)
 
-    def _dispatch(self, batch) -> None:
+    def _expire(self, batch: list) -> list:
+        """Resolve requests whose deadline passed while queued with
+        QuESTTimeoutError; return the still-live remainder."""
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now >= req.deadline:
+                telemetry.inc("engine_request_timeouts_total")
+                if not req.fut.done():
+                    req.fut.set_exception(QuESTTimeoutError(
+                        f"request deadline expired after "
+                        f"{now - req.t0:.3f}s in queue "
+                        f"(timeout={req.deadline - req.t0:.3f}s)",
+                        "Engine.submit"))
+            else:
+                live.append(req)
+        return live
+
+    def _mode(self) -> str:
         # unsharded engines with batching enabled ALWAYS run the one
         # fixed-shape padded vmap program, even for a lone request: every
         # request then executes in an identical batch lane of the identical
@@ -274,44 +387,86 @@ class Engine:
         # share accumulation order, so a separate B=1 program would drift
         # ~1 ulp per gate) -- and exactly one executable ever compiles.
         # max_batch=1 opts out for latency-only deployments.
-        mode = ("vmap" if (not self.sharded and self.max_batch > 1
+        return ("vmap" if (not self.sharded and self.max_batch > 1
                            and self._lifted.slots) else "sequential")
+
+    def _dispatch(self, batch: list) -> None:
+        mode = self._mode()
         telemetry.inc("engine_batches_total", mode=mode)
         telemetry.observe("engine_batch_size", len(batch))
         try:
             with telemetry.span("engine.dispatch", mode=mode,
                                 batch=len(batch)):
-                if mode == "vmap":
-                    self._dispatch_vmap(batch)
-                else:
-                    self._dispatch_sequential(batch)
-        except BaseException as e:  # a bad batch must not kill the server
-            for _, fut, _ in batch:
-                if not fut.done():
-                    fut.set_exception(e)
+                self._dispatch_one(batch, mode)
+        except Exception:
+            # a failed batch bisects through the same executable: healthy
+            # requests complete bit-identically, poisoned ones carry their
+            # own exception -- one bad parameter set never fails neighbors
+            self._bisect(batch, mode)
+        except BaseException as e:  # interpreter teardown must not hang waiters
+            for req in batch:
+                if not req.fut.done():
+                    req.fut.set_exception(e)
         now = time.perf_counter()
-        for _, _, t0 in batch:
-            telemetry.observe("engine_request_latency_seconds", now - t0)
+        for req in batch:
+            telemetry.observe("engine_request_latency_seconds", now - req.t0)
 
-    def _dispatch_sequential(self, batch) -> None:
+    def _dispatch_one(self, batch: list, mode: str) -> None:
+        if mode == "vmap":
+            self._dispatch_vmap(batch)
+        else:
+            self._dispatch_sequential(batch)
+
+    def _bisect(self, batch: list, mode: str) -> None:
+        telemetry.inc("engine_bisections_total")
+        if len(batch) == 1:
+            req = batch[0]
+            try:
+                self._dispatch_one(batch, mode)
+            except BaseException as e:
+                if req.poison is not None:
+                    telemetry.inc("engine_poisoned_requests_total")
+                if not req.fut.done():
+                    req.fut.set_exception(e)
+            return
+        mid = len(batch) // 2
+        for half in (batch[:mid], batch[mid:]):
+            try:
+                self._dispatch_one(half, mode)
+            except BaseException:
+                self._bisect(half, mode)
+
+    def _dispatch_sequential(self, batch: list) -> None:
         x = self._exec1()
-        for values, fut, _ in batch:
-            fut.set_result(x.with_values(self.initial_amps + 0, values))
+        for req in batch:
+            if req.poison is not None:
+                raise PoisonedRequestFault("engine.request", req.poison)
+            res = x.with_values(self.initial_amps + 0, req.values)
+            if not req.fut.done():
+                req.fut.set_result(res)
 
-    def _dispatch_vmap(self, batch) -> None:
+    def _dispatch_vmap(self, batch: list) -> None:
         import jax.numpy as jnp
 
+        for req in batch:
+            # an injected poisoned request fails the whole batched program
+            # (the real-world analogue: one NaN-producing parameter set or
+            # device-rejected lane) -- _bisect isolates it
+            if req.poison is not None:
+                raise PoisonedRequestFault("engine.request", req.poison)
         if not self._lifted.slots:
             # value-free structure: every request computes the same state
             out = self._exec1().with_values(self.initial_amps + 0, ())
-            for _, fut, _ in batch:
-                fut.set_result(out)
+            for req in batch:
+                if not req.fut.done():
+                    req.fut.set_result(out)
             return
         pad = self.max_batch - len(batch)
-        vals = [v for v, _, _ in batch] + [batch[-1][0]] * pad
+        vals = [req.values for req in batch] + [batch[-1].values] * pad
         stacked = tuple(jnp.stack([v[k] for v in vals])
                         for k in range(len(self._lifted.slots)))
         amps_b = jnp.repeat(self.initial_amps[None], self.max_batch, axis=0)
         out = self._execB()(amps_b, stacked)
-        for i, (_, fut, _) in enumerate(batch):
-            fut.set_result(out[i])
+        for i, req in enumerate(batch):
+            if not req.fut.done():
+                req.fut.set_result(out[i])

@@ -1302,6 +1302,7 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
     from .ops import pallas_gates as PG
     from .ops.pallas_gates import fused_local_run, swap_bit_blocks
     from .parallel import scheduler as _dist
+    from .resilience import guard as _guard
 
     import jax
 
@@ -1331,12 +1332,20 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
     # counted grouped collectives (ISSUE 3 tentpole) ---
     if (sched is not None and sched.mesh is not None
             and sched.mesh.size > 1 and _df_route(qureg.dtype)):
-        if _sched_df_pallas_run(qureg, ops, sched, tile_bits, load_swap_k,
-                                store_swap_k, load_swap_hi, store_swap_hi,
-                                ring_depth):
+        # the whole sched-df route is idempotent until its final put
+        # (planes re-split from qureg.amps per invocation), so the guard
+        # may retry it wholesale; injected compile faults degrade to the
+        # engine replay below (reason=fault_degraded)
+        res = _guard.pallas_dispatch(
+            lambda: _sched_df_pallas_run(
+                qureg, ops, sched, tile_bits, load_swap_k, store_swap_k,
+                load_swap_hi, store_swap_hi, ring_depth),
+            degrade=lambda: None)
+        if res is not _guard.DEGRADED and res:
             return
         # not shard-executable at the df tile geometry (reason counted
-        # inside): sharding-aware engine replay, explicit swap passes
+        # inside) or fault-degraded: sharding-aware engine replay,
+        # explicit swap passes
         pre_swap()
         _apply_ops_via_engine(qureg, ops)
         post_swap()
@@ -1414,7 +1423,6 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                 telemetry.inc("engine_fallback_total",
                               reason="swap_not_foldable")
                 pre_swap()
-            planes = df_split(qureg.amps)
             # Mosaic compile time is superlinear in op count and df ops
             # carry ~15x the arithmetic, so long runs split into short
             # kernels chained on the (4, N) planes -- extra HBM passes
@@ -1428,19 +1436,33 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                 telemetry.inc("engine_fallback_total", len(chunks) - 1,
                               reason="df_max_ops_split")
             last = len(chunks) - 1
-            for ci, chunk in enumerate(chunks):
-                planes = fused_local_run(
-                    planes, n=nsv, ops=chunk, sublanes=DF_SUBLANES,
-                    load_swap_k=load_swap_k if (foldable and ci == 0)
-                    else 0,
-                    store_swap_k=store_swap_k if (foldable and ci == last)
-                    else 0,
-                    load_swap_hi=load_swap_hi if (foldable and ci == 0)
-                    else None,
-                    store_swap_hi=store_swap_hi if (foldable and ci == last)
-                    else None,
-                    ring_depth=ring_depth)
-            qureg.put(df_join(planes))
+
+            def df_attempt():
+                planes = df_split(qureg.amps)
+                for ci, chunk in enumerate(chunks):
+                    planes = fused_local_run(
+                        planes, n=nsv, ops=chunk, sublanes=DF_SUBLANES,
+                        load_swap_k=load_swap_k if (foldable and ci == 0)
+                        else 0,
+                        store_swap_k=store_swap_k
+                        if (foldable and ci == last) else 0,
+                        load_swap_hi=load_swap_hi if (foldable and ci == 0)
+                        else None,
+                        store_swap_hi=store_swap_hi
+                        if (foldable and ci == last) else None,
+                        ring_depth=ring_depth)
+                return df_join(planes)
+
+            def df_degrade():
+                if foldable:
+                    pre_swap()
+                _apply_ops_via_engine(qureg, ops)
+                if foldable:
+                    post_swap()
+
+            out = _guard.pallas_dispatch(df_attempt, df_degrade)
+            if out is not _guard.DEGRADED:
+                qureg.put(out)
             if k_max and not foldable:
                 post_swap()
             return
@@ -1463,13 +1485,26 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
     if k_max and not foldable:
         telemetry.inc("engine_fallback_total", reason="swap_not_foldable")
         pre_swap()
-    qureg.put(fused_local_run(
-        qureg.amps, n=nsv, ops=ops,
-        load_swap_k=load_swap_k if foldable else 0,
-        store_swap_k=store_swap_k if foldable else 0,
-        load_swap_hi=load_swap_hi if foldable else None,
-        store_swap_hi=store_swap_hi if foldable else None,
-        ring_depth=ring_depth))
+
+    def local_attempt():
+        return fused_local_run(
+            qureg.amps, n=nsv, ops=ops,
+            load_swap_k=load_swap_k if foldable else 0,
+            store_swap_k=store_swap_k if foldable else 0,
+            load_swap_hi=load_swap_hi if foldable else None,
+            store_swap_hi=store_swap_hi if foldable else None,
+            ring_depth=ring_depth)
+
+    def local_degrade():
+        if foldable:
+            pre_swap()
+        _apply_ops_via_engine(qureg, ops)
+        if foldable:
+            post_swap()
+
+    out = _guard.pallas_dispatch(local_attempt, local_degrade)
+    if out is not _guard.DEGRADED:
+        qureg.put(out)
     if k_max and not foldable:
         post_swap()
 
@@ -1648,12 +1683,29 @@ def _dispatch_pallas_sharded(qureg, ops: tuple, mesh, tile_bits: int,
     fold_s = foldable(sk, sh)
     if lk and not fold_l:
         pre_swap()
-    new = _exec_pallas_sharded(
-        qureg.amps, mesh, ops, df, n_local, sublanes,
-        lk=lk if fold_l else 0, lh=lh if fold_l else None,
-        sk=sk if fold_s else 0, sh=sh if fold_s else None,
-        ring_depth=ring_depth)
-    qureg.put(new)
+
+    from .resilience import guard as _guard
+
+    def attempt():
+        return _exec_pallas_sharded(
+            qureg.amps, mesh, ops, df, n_local, sublanes,
+            lk=lk if fold_l else 0, lh=lh if fold_l else None,
+            sk=sk if fold_s else 0, sh=sh if fold_s else None,
+            ring_depth=ring_depth)
+
+    def degrade():
+        # the kernel route stays down (injected compile fault / exhausted
+        # transients): sharding-aware engine replay; swaps that would have
+        # folded into the kernel DMA run as explicit passes instead
+        if fold_l:
+            pre_swap()
+        _apply_ops_via_engine(qureg, ops)
+        if fold_s:
+            post_swap()
+
+    new = _guard.pallas_dispatch(attempt, degrade)
+    if new is not _guard.DEGRADED:
+        qureg.put(new)
     if sk and not fold_s:
         post_swap()
     return True

@@ -58,6 +58,16 @@ def _specs(mesh):
     return dict(mesh=mesh, in_specs=P(None, AMP_AXIS), out_specs=P(None, AMP_AXIS))
 
 
+def _launch(kernel, mesh, amps):
+    """The one launch point for every collective kernel here, threaded
+    through the resilience guard (site ``exchange.collective``): a direct
+    call when no fault plan is installed; injected transient comm faults
+    retry under the backoff policy and exhaustion fails closed with a
+    typed QuESTRetryError (quest_tpu.resilience.guard.collective)."""
+    from ..resilience import guard
+    return guard.collective(lambda: shard_map(kernel, **_specs(mesh))(amps))
+
+
 def _rank_bit(r, q, nl):
     return (r >> (q - nl)) & 1
 
@@ -146,7 +156,7 @@ def dist_apply_matrix1(amps, matrix, *, n: int, target: int,
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return shard_map(kernel, **_specs(mesh))(amps)
+    return _launch(kernel, mesh, amps)
 
 
 def dist_apply_local_matrix(amps, matrix, *, n: int, targets: tuple[int, ...],
@@ -172,7 +182,7 @@ def dist_apply_local_matrix(amps, matrix, *, n: int, targets: tuple[int, ...],
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return shard_map(kernel, **_specs(mesh))(amps)
+    return _launch(kernel, mesh, amps)
 
 
 # ---------------------------------------------------------------------------
@@ -211,7 +221,7 @@ def dist_apply_x(amps, *, n: int, targets: tuple[int, ...],
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return shard_map(kernel, **_specs(mesh))(amps)
+    return _launch(kernel, mesh, amps)
 
 
 # ---------------------------------------------------------------------------
@@ -366,7 +376,7 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
     if mesh is None or mesh.size == 1:
         assert m == 0 and rho_src is None
         return kernel(amps)
-    return shard_map(kernel, **_specs(mesh))(amps)
+    return _launch(kernel, mesh, amps)
 
 def dist_apply_diag_phase(amps, diag, *, n: int, targets: tuple[int, ...],
                           controls: tuple[int, ...] = (),
@@ -404,7 +414,7 @@ def dist_apply_diag_phase(amps, diag, *, n: int, targets: tuple[int, ...],
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return shard_map(kernel, **_specs(mesh))(amps)
+    return _launch(kernel, mesh, amps)
 
 
 def dist_apply_parity_phase(amps, theta, *, n: int, qubits: tuple[int, ...],
@@ -440,7 +450,7 @@ def dist_apply_parity_phase(amps, theta, *, n: int, qubits: tuple[int, ...],
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return shard_map(kernel, **_specs(mesh))(amps)
+    return _launch(kernel, mesh, amps)
 
 
 # ---------------------------------------------------------------------------
@@ -503,4 +513,4 @@ def dist_swap(amps, *, n: int, qb1: int, qb2: int, mesh: Mesh):
         new = jnp.stack([new0, new1], axis=ax)
         return new.reshape(own.shape)
 
-    return shard_map(kernel, **_specs(mesh))(amps)
+    return _launch(kernel, mesh, amps)

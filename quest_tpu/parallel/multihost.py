@@ -24,16 +24,91 @@ and seeding is deterministic given the same user-provided seeds.
 
 from __future__ import annotations
 
+import inspect
 import os
 
 import jax
 
+from ..validation import QuESTError
+
 __all__ = ["init", "is_multihost", "process_info"]
+
+_DEF_TIMEOUT_S = 300.0
+
+
+def _is_initialized() -> bool:
+    """Whether the jax distributed runtime is already up. jax >= 0.5 has
+    ``jax.distributed.is_initialized()``; older releases (this container's
+    0.4.x) expose only the global client state -- probing it avoids the
+    AttributeError that silently broke ``init`` on 0.4.37."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _d
+        return _d.global_state.client is not None
+    except Exception:
+        return False
+
+
+def _resolve_timeout(initialization_timeout: float | None) -> float:
+    if initialization_timeout is not None:
+        return float(initialization_timeout)
+    raw = os.environ.get("QUEST_INIT_TIMEOUT_S", "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            from ..analysis.diagnostics import emit_findings, make_finding
+            emit_findings([make_finding(
+                "QT303", f"QUEST_INIT_TIMEOUT_S={raw!r} is not numeric; "
+                "using the default", "parallel.multihost")])
+    return _DEF_TIMEOUT_S
+
+
+def _probe_coordinator(coordinator_address: str, timeout_s: float) -> None:
+    """Bounded TCP reachability check of the coordinator, retried until
+    ``timeout_s``. jax 0.4.x's distributed client turns a RegisterTask
+    deadline into an absl FATAL that *aborts the process* (client.h:80) --
+    no Python exception ever surfaces -- so a missing/unreachable
+    coordinator must be caught HERE, before handing off, to fail typed."""
+    import socket
+    import time
+
+    host, _, port_s = coordinator_address.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise QuESTError(
+            f"coordinator address {coordinator_address!r} is not host:port",
+            "multihost.init") from None
+    deadline = time.monotonic() + timeout_s
+    last: Exception | None = None
+    while True:
+        try:
+            with socket.create_connection(
+                    (host or "127.0.0.1", port),
+                    timeout=max(0.1, min(2.0, timeout_s))):
+                return
+        except OSError as e:
+            last = e
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(min(0.2, timeout_s / 10))
+    from ..analysis.diagnostics import emit_findings, make_finding
+    emit_findings([make_finding(
+        "QT301", f"coordinator {coordinator_address!r} unreachable within "
+        f"{timeout_s:g}s: {last}", "parallel.multihost.init")])
+    raise QuESTError(
+        f"multi-host initialization failed against coordinator "
+        f"{coordinator_address!r} within the {timeout_s:g}s "
+        f"initialization_timeout: {last} [QT301]", "multihost.init")
 
 
 def init(coordinator_address: str | None = None,
          num_processes: int | None = None,
-         process_id: int | None = None) -> None:
+         process_id: int | None = None,
+         initialization_timeout: float | None = None) -> None:
     """Initialise cross-host communication (idempotent; no-op when the
     JAX runtime already knows its topology, e.g. TPU pod metadata).
 
@@ -42,25 +117,53 @@ def init(coordinator_address: str | None = None,
     JAX_PROCESS_ID, exactly like mpirun's rank/size but resolved by the
     JAX distributed runtime instead of an MPI launcher.
 
+    ``initialization_timeout`` (seconds; default ``QUEST_INIT_TIMEOUT_S``
+    or 300) bounds the wait for the coordinator: a missing or unreachable
+    coordinator raises a QuESTError naming the timeout (flight-recorded
+    QT301) instead of hanging the process indefinitely -- the ISSUE 7
+    resilience contract for cluster bring-up.
+
     Must run before anything touches the XLA backend (jax.distributed's
     own contract) -- so the already-initialised check goes through
-    jax.distributed.is_initialized(), NOT jax.process_count(), which
-    would itself initialise the backend (found by the round-4 2-process
-    smoke test, tests/test_multihost.py)."""
-    if jax.distributed.is_initialized():
+    :func:`_is_initialized`, NOT jax.process_count(), which would itself
+    initialise the backend (found by the round-4 2-process smoke test,
+    tests/test_multihost.py)."""
+    if _is_initialized():
         return
+    timeout_s = _resolve_timeout(initialization_timeout)
+    kwargs = {}
+    if "initialization_timeout" in inspect.signature(
+            jax.distributed.initialize).parameters:
+        # jax wants whole seconds; never round a positive timeout to zero
+        kwargs["initialization_timeout"] = max(1, int(timeout_s))
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None and num_processes is None:
         # single host, or TPU-pod autodetection at first backend use
         try:
-            jax.distributed.initialize()
+            jax.distributed.initialize(**kwargs)
         except Exception:
             pass  # single-process environments: nothing to do
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes, process_id=process_id)
+    if process_id not in (None, 0):
+        # process 0 hosts the coordination service itself (nothing to probe
+        # before it binds); every other process must reach it over TCP
+        _probe_coordinator(coordinator_address, timeout_s)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id, **kwargs)
+    except Exception as e:
+        from ..analysis.diagnostics import emit_findings, make_finding
+
+        emit_findings([make_finding(
+            "QT301", f"multi-host initialization failed against "
+            f"coordinator {coordinator_address!r} within {timeout_s:g}s: "
+            f"{e}", "parallel.multihost.init")])
+        raise QuESTError(
+            f"multi-host initialization failed against coordinator "
+            f"{coordinator_address!r} within the {timeout_s:g}s "
+            f"initialization_timeout: {e} [QT301]", "multihost.init") from e
 
 
 def is_multihost() -> bool:

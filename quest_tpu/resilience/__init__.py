@@ -1,0 +1,59 @@
+"""Resilience layer: fault injection, retry/backoff, and preemption-safe
+segmented execution (ISSUE 7).
+
+The reference fails closed and fails whole -- QuEST validates inputs and
+then assumes every MPI exchange, kernel launch, and file write succeeds.
+Serving production traffic (ROADMAP north star) needs every failure mode
+to be *injectable*, *observed*, and either retried to a bit-identical
+result or failed closed with a typed error. Four pieces:
+
+- :mod:`.faultinject` -- seeded deterministic fault plans
+  (``QUEST_FAULTS=site:kind:nth[,...]``) fired at named sites in the hot
+  paths; no-ops (one boolean read) when disabled, counted
+  ``fault_injected_total{site,kind}`` when they fire.
+- :mod:`.retry` -- deadline-aware exponential backoff with deterministic
+  jitter, counted ``retry_attempts_total{site,outcome}``.
+- :mod:`.guard` -- per-site wrappers tying the two together: Pallas
+  dispatch retries transients then degrades along the existing fallback
+  lattice (``engine_fallback_total{reason=fault_degraded}``); collectives
+  retry then fail closed; checkpoint writes absorb injected torn/corrupt
+  payloads for the verified loader to catch.
+- :mod:`.segmented` -- ``Circuit.run_segmented`` / :func:`resume_segmented`:
+  checkpointed execution at frame-identity boundaries with CRC-verified
+  generation fallback.
+
+Typed errors (:mod:`.errors`) subclass
+:class:`~quest_tpu.validation.QuESTError`:
+``QuESTTimeoutError`` (engine deadline), ``QuESTBackpressureError``
+(bounded queue full), ``QuESTCancelledError`` (dropped by
+``close(drain=False)``), ``QuESTPreemptionError`` (carries the resume
+cursor), ``QuESTRetryError`` (retry budget spent, no degradation path).
+
+See docs/resilience.md for the failure-mode table and tools/chaos.py for
+the one-fault-per-site CI drill.
+"""
+
+from .errors import (  # noqa: F401
+    InjectedFault, KernelCompileFault, PoisonedRequestFault,
+    QuESTBackpressureError, QuESTCancelledError, QuESTPreemptionError,
+    QuESTRetryError, QuESTTimeoutError, TransientFault,
+)
+from .faultinject import (  # noqa: F401
+    SITES, FaultPlan, FaultSpec, active_plan, clear, enabled, fault_plan,
+    fire, install,
+)
+from .retry import RetryPolicy, call_with_retry, default_policy  # noqa: F401
+from .segmented import (  # noqa: F401
+    resume_segmented, run_segmented, segment_plan,
+)
+
+__all__ = [
+    "QuESTTimeoutError", "QuESTBackpressureError", "QuESTCancelledError",
+    "QuESTPreemptionError", "QuESTRetryError",
+    "InjectedFault", "TransientFault", "KernelCompileFault",
+    "PoisonedRequestFault",
+    "SITES", "FaultPlan", "FaultSpec", "enabled", "active_plan", "install",
+    "clear", "fault_plan", "fire",
+    "RetryPolicy", "default_policy", "call_with_retry",
+    "segment_plan", "run_segmented", "resume_segmented",
+]

@@ -1,0 +1,86 @@
+"""Typed failure vocabulary for the resilience layer.
+
+The reference fails whole: ``exitWithError`` (QuEST_validation.c:154)
+prints and aborts the process, so every failure is terminal and untyped.
+Serving production traffic needs the opposite contract -- each failure
+mode carries its own type so callers (and the engine's batcher) can route
+it: retry :class:`TransientFault`, degrade on :class:`KernelCompileFault`,
+isolate :class:`PoisonedRequestFault` to its request, resume after
+:class:`QuESTPreemptionError`, and surface deadline/queue pressure as
+:class:`QuESTTimeoutError` / :class:`QuESTBackpressureError`.
+
+Injected faults (raised by :mod:`.faultinject` at named sites) derive from
+:class:`InjectedFault`; user-facing terminal errors derive from
+:class:`~quest_tpu.validation.QuESTError` so existing ``except QuESTError``
+handlers keep working.
+"""
+
+from __future__ import annotations
+
+from ..validation import QuESTError
+
+__all__ = [
+    "QuESTTimeoutError", "QuESTBackpressureError", "QuESTCancelledError",
+    "QuESTPreemptionError", "QuESTRetryError",
+    "InjectedFault", "TransientFault", "KernelCompileFault",
+    "PoisonedRequestFault",
+]
+
+
+class QuESTTimeoutError(QuESTError):
+    """A request's deadline expired before the engine dispatched it."""
+
+
+class QuESTBackpressureError(QuESTError):
+    """The engine queue is at ``QUEST_ENGINE_QUEUE_MAX``; the submit was
+    rejected rather than growing the queue unboundedly."""
+
+
+class QuESTCancelledError(QuESTError):
+    """The request was dropped by ``Engine.close(drain=False)`` before
+    dispatch; the future resolves with this instead of dangling."""
+
+
+class QuESTPreemptionError(QuESTError):
+    """Execution was preempted between segments of a segmented run.
+
+    Carries ``cursor`` (the tape index of the last verified checkpoint)
+    and ``checkpoint_dir`` so the caller can hand both straight to
+    :func:`~quest_tpu.resilience.segmented.resume_segmented`."""
+
+    def __init__(self, message: str, func: str = "",
+                 cursor: int | None = None,
+                 checkpoint_dir: str | None = None):
+        super().__init__(message, func)
+        self.cursor = cursor
+        self.checkpoint_dir = checkpoint_dir
+
+
+class QuESTRetryError(QuESTError):
+    """A retryable site stayed faulty past the retry policy's attempt or
+    deadline budget and has no degradation path (fail closed)."""
+
+
+class InjectedFault(RuntimeError):
+    """Base for faults raised by :mod:`~quest_tpu.resilience.faultinject`
+    at a named site (never raised when ``QUEST_FAULTS`` is unset)."""
+
+    def __init__(self, site: str, kind: str):
+        super().__init__(f"injected {kind} fault at site {site!r}")
+        self.site = site
+        self.kind = kind
+
+
+class TransientFault(InjectedFault):
+    """A fault that a retry is expected to clear (device hiccup, dropped
+    collective) -- the retryable class for :mod:`.retry`."""
+
+
+class KernelCompileFault(InjectedFault):
+    """A permanent kernel-route failure (compile error): retrying cannot
+    help, the guard degrades along the engine fallback lattice."""
+
+
+class PoisonedRequestFault(InjectedFault):
+    """A single poisoned request inside an engine batch: the batcher must
+    isolate it to its own future, not fail its neighbors."""

@@ -1,0 +1,274 @@
+"""Seeded, deterministic fault injection at named execution sites.
+
+The hot paths carry named sites (table below). Each site calls
+:func:`fire` (or :func:`check` / :func:`corrupt_file`) exactly once per
+visit; with no plan installed the call is a no-op returning ``None`` --
+the disabled path is one module-level boolean read, so production traffic
+pays nothing. A plan (``QUEST_FAULTS`` env or an explicit
+:class:`FaultPlan`) names *which visit* of *which site* fails *how*:
+
+    QUEST_FAULTS=site:kind:nth[,site:kind:nth...]
+
+``nth`` is the 1-based visit count at which the fault fires (``3`` = the
+third visit only; ``3+`` = every visit from the third on -- the form
+exhaustion tests use). Because visits are counted, not sampled, a fault
+plan replays identically run over run: the determinism the bit-identity
+recovery proofs in tests/test_resilience.py rely on.
+
+Sites and their kinds (the failure-mode table in docs/resilience.md):
+
+==================== ======================= ===========================
+site                 kinds                   raised / effect
+==================== ======================= ===========================
+``pallas.dispatch``  ``transient, compile``  TransientFault (retried) /
+                                             KernelCompileFault (degrade)
+``exchange.collective`` ``transient``        TransientFault (retried;
+                                             exhaustion fails closed)
+``engine.request``   ``poison``              PoisonedRequestFault pinned
+                                             to one request at submit
+``checkpoint.write`` ``torn, corrupt, io``   truncate / bit-flip the
+                                             just-written shard; ``io``
+                                             raises TransientFault
+``segment.boundary`` ``preempt``             QuESTPreemptionError between
+                                             segments (after checkpoint)
+==================== ======================= ===========================
+
+Every fired fault counts ``fault_injected_total{site,kind}``. Malformed
+or unknown ``QUEST_FAULTS`` entries are skipped with a QT302 diagnostic
+(flight-recorded, warning severity) -- a typo'd plan must not take down a
+production process that merely inherited the env var.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterator, NamedTuple
+
+from .. import telemetry
+from ..validation import QuESTError
+from .errors import (InjectedFault, KernelCompileFault, PoisonedRequestFault,
+                     QuESTPreemptionError, TransientFault)
+
+__all__ = ["SITES", "FaultSpec", "FaultPlan", "enabled", "active_plan",
+           "install", "clear", "fault_plan", "fire", "check",
+           "corrupt_file"]
+
+ENV_VAR = "QUEST_FAULTS"
+
+#: site name -> kinds a plan may inject there
+SITES: dict[str, tuple[str, ...]] = {
+    "pallas.dispatch": ("transient", "compile"),
+    "exchange.collective": ("transient",),
+    "engine.request": ("poison",),
+    "checkpoint.write": ("torn", "corrupt", "io"),
+    "segment.boundary": ("preempt",),
+}
+
+_EXC: dict[str, type[InjectedFault]] = {
+    "transient": TransientFault,
+    "io": TransientFault,
+    "compile": KernelCompileFault,
+    "poison": PoisonedRequestFault,
+}
+
+
+class FaultSpec(NamedTuple):
+    """One ``site:kind:nth`` entry; ``from_nth_on`` marks the ``nth+``
+    every-visit-from-then-on form."""
+    site: str
+    kind: str
+    nth: int
+    from_nth_on: bool = False
+
+    def matches(self, visit: int) -> bool:
+        return visit >= self.nth if self.from_nth_on else visit == self.nth
+
+
+def _qt302(entry: str, why: str) -> None:
+    from ..analysis.diagnostics import emit_findings, make_finding
+    emit_findings([make_finding(
+        "QT302", f"QUEST_FAULTS entry {entry!r} ignored: {why}",
+        "resilience.faultinject")])
+
+
+class FaultPlan:
+    """A parsed fault plan: specs plus per-site visit counters (the
+    counters live on the plan, so installing a fresh plan restarts the
+    deterministic visit numbering)."""
+
+    def __init__(self, specs: Iterator[FaultSpec] | tuple = ()):
+        self.specs: tuple[FaultSpec, ...] = tuple(specs)
+        self._visits: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, text: str, strict: bool = False) -> "FaultPlan":
+        """Parse ``site:kind:nth[,...]``; unknown/malformed entries are
+        skipped with a QT302 diagnostic, or raise when ``strict``."""
+        specs = []
+        for entry in filter(None, (e.strip() for e in text.split(","))):
+            parts = entry.split(":")
+            why = None
+            if len(parts) != 3:
+                why = "expected site:kind:nth"
+            else:
+                site, kind, nth_s = parts
+                from_on = nth_s.endswith("+")
+                if site not in SITES:
+                    why = f"unknown site (one of {sorted(SITES)})"
+                elif kind not in SITES[site]:
+                    why = f"kind not valid for site (one of {SITES[site]})"
+                elif not nth_s.rstrip("+").isdigit() \
+                        or int(nth_s.rstrip("+")) < 1:
+                    why = "nth must be a positive integer (optionally 'N+')"
+            if why is not None:
+                if strict:
+                    raise QuESTError(
+                        f"bad QUEST_FAULTS entry {entry!r}: {why} [QT302]",
+                        "FaultPlan.parse")
+                _qt302(entry, why)
+                continue
+            specs.append(FaultSpec(site, kind, int(nth_s.rstrip("+")),
+                                   from_on))
+        return cls(specs)
+
+    def visits(self, site: str) -> int:
+        """How many times ``site`` has fired so far (test introspection)."""
+        with self._lock:
+            return self._visits.get(site, 0)
+
+    def fire(self, site: str) -> str | None:
+        """Record one visit of ``site``; return the fault kind to inject
+        on this visit, or None."""
+        with self._lock:
+            visit = self._visits.get(site, 0) + 1
+            self._visits[site] = visit
+        for spec in self.specs:
+            if spec.site == site and spec.matches(visit):
+                telemetry.inc("fault_injected_total", site=site,
+                              kind=spec.kind)
+                telemetry.event("resilience.fault", site=site,
+                                kind=spec.kind, visit=visit)
+                return spec.kind
+        return None
+
+
+# -- module-level plan management (the zero-cost disabled path) -------------
+
+_active: FaultPlan | None = None
+_env_read = False
+_state_lock = threading.Lock()
+
+
+def _load_env() -> None:
+    global _active, _env_read
+    with _state_lock:
+        if _env_read:
+            return
+        _env_read = True
+        text = os.environ.get(ENV_VAR, "").strip()
+        if text:
+            plan = FaultPlan.parse(text)
+            if plan.specs:
+                _active = plan
+
+
+def enabled() -> bool:
+    """True when a fault plan is installed (env or explicit). The first
+    call reads ``QUEST_FAULTS`` once; afterwards this is one boolean."""
+    if not _env_read:
+        _load_env()
+    return _active is not None
+
+
+def active_plan() -> FaultPlan | None:
+    """The installed plan, or None."""
+    if not _env_read:
+        _load_env()
+    return _active
+
+
+def install(plan: FaultPlan | str | None) -> None:
+    """Install ``plan`` (a :class:`FaultPlan`, a spec string, or None to
+    disable), replacing whatever was active."""
+    global _active, _env_read
+    with _state_lock:
+        _env_read = True
+        _active = (FaultPlan.parse(plan, strict=True)
+                   if isinstance(plan, str) else plan)
+
+
+def clear() -> None:
+    """Remove any installed plan (injection sites become no-ops again)."""
+    install(None)
+
+
+@contextlib.contextmanager
+def fault_plan(plan: FaultPlan | str):
+    """Context manager installing ``plan`` for the block (tests/chaos);
+    restores the previous plan -- and its visit counters -- on exit."""
+    global _active, _env_read
+    prev, prev_read = _active, _env_read
+    install(plan)
+    try:
+        yield active_plan()
+    finally:
+        with _state_lock:
+            _active, _env_read = prev, prev_read
+
+
+def fire(site: str) -> str | None:
+    """The injection-site primitive: no-op (None) when disabled, else
+    delegate to the plan's visit-counted matcher."""
+    if _active is None and _env_read:
+        return None
+    if not enabled():
+        return None
+    plan = _active
+    return plan.fire(site) if plan is not None else None
+
+
+def check(site: str) -> None:
+    """Visit ``site`` and raise the mapped typed fault if the plan says
+    this visit fails; no-op when disabled."""
+    kind = fire(site)
+    if kind is None:
+        return
+    exc = _EXC.get(kind)
+    if exc is not None:
+        raise exc(site, kind)
+    if kind == "preempt":
+        raise QuESTPreemptionError(
+            f"injected preemption at site {site!r}", site)
+    # torn/corrupt only make sense via corrupt_file(); reaching here means
+    # a site miswired the helper -- surface loudly rather than pass
+    raise QuESTError(f"fault kind {kind!r} at {site!r} needs corrupt_file()",
+                     "faultinject.check")
+
+
+def corrupt_file(site: str, path: str) -> str | None:
+    """Visit ``site``; apply a file-level fault to ``path`` (``torn``
+    truncates the tail half, ``corrupt`` flips one payload byte) or raise
+    for raisable kinds. Returns the kind applied, or None."""
+    kind = fire(site)
+    if kind is None:
+        return None
+    if kind == "torn":
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        return kind
+    if kind == "corrupt":
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(max(0, size // 2))
+            b = f.read(1)
+            f.seek(max(0, size // 2))
+            f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+        return kind
+    exc = _EXC.get(kind)
+    if exc is not None:
+        raise exc(site, kind)
+    return kind

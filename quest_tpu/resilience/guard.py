@@ -1,0 +1,144 @@
+"""Site guards: where fault injection, retry, and degradation meet.
+
+Each hot path wraps its failable operation in one of these guards. When no
+fault plan is installed (the production default) every guard is a direct
+call -- one boolean read of :func:`faultinject.enabled` -- so the suite's
+zero-new-fallbacks acceptance criterion holds by construction.
+
+With a plan installed the guard visits its site (which may raise a typed
+:class:`~quest_tpu.resilience.errors.InjectedFault`), retries transients
+under the :mod:`.retry` policy, and on exhaustion takes the site's
+documented exit:
+
+- ``pallas.dispatch``    -- degrade along the EXISTING fallback lattice
+  (the caller's engine-replay path), counted
+  ``engine_fallback_total{reason=fault_degraded}``;
+- ``exchange.collective`` -- fail closed with
+  :class:`~quest_tpu.resilience.errors.QuESTRetryError` (a collective
+  that stays down has no single-device rewrite at this layer);
+- ``checkpoint.write``   -- retried ``io`` faults, torn/corrupt payload
+  mutations applied post-write so verification (CRC) catches them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+from .. import telemetry
+from . import faultinject, retry
+from .errors import (KernelCompileFault, QuESTRetryError, TransientFault)
+
+__all__ = ["DEGRADED", "pallas_dispatch", "collective", "checkpoint_write",
+           "segment_boundary"]
+
+T = TypeVar("T")
+
+#: sentinel returned by :func:`pallas_dispatch` when the degrade path ran
+DEGRADED = object()
+
+
+def pallas_dispatch(attempt: Callable[[], T],
+                    degrade: Callable[[], object] | None = None,
+                    *, site: str = "pallas.dispatch"):
+    """Run a kernel-route ``attempt``: retry injected transients; on a
+    compile fault or retry exhaustion run ``degrade`` (the caller's
+    engine-replay closure) and return :data:`DEGRADED`, counting the
+    degradation on the existing fallback series."""
+    if not faultinject.enabled():
+        return attempt()
+
+    def guarded() -> T:
+        faultinject.check(site)
+        return attempt()
+
+    try:
+        return retry.call_with_retry(guarded, site=site)
+    except (KernelCompileFault, TransientFault) as e:
+        if degrade is None:
+            raise
+        telemetry.inc("engine_fallback_total", reason="fault_degraded")
+        telemetry.event("resilience.degrade", site=site,
+                        kind=getattr(e, "kind", type(e).__name__))
+        degrade()
+        return DEGRADED
+
+
+def collective(fn: Callable[[], T], *,
+               site: str = "exchange.collective") -> T:
+    """Run a collective launch: retry injected transients, fail closed
+    with a typed :class:`QuESTRetryError` when the budget is spent."""
+    if not faultinject.enabled():
+        return fn()
+
+    def guarded() -> T:
+        faultinject.check(site)
+        return fn()
+
+    try:
+        return retry.call_with_retry(guarded, site=site)
+    except TransientFault as e:
+        raise QuESTRetryError(
+            f"collective at {site!r} still failing after retry budget "
+            f"({e})", site) from e
+
+
+def checkpoint_write(write: Callable[[], str],
+                     *, site: str = "checkpoint.write") -> str:
+    """Run a shard ``write`` (returning the final path): retry transient
+    ``io`` faults, then apply any torn/corrupt payload fault to the
+    written file -- the verified-load machinery must catch it."""
+    if not faultinject.enabled():
+        return write()
+
+    def guarded() -> str:
+        kind = faultinject.fire(site)
+        if kind == "io":
+            raise TransientFault(site, kind)
+        path = write()
+        if kind == "torn":
+            size = max(1, _size(path) // 2)
+            with open(path, "r+b") as f:
+                f.truncate(size)
+        elif kind == "corrupt":
+            _flip_payload(path)
+        return path
+
+    return retry.call_with_retry(guarded, site=site)
+
+
+def _size(path: str) -> int:
+    import os
+    return os.path.getsize(path)
+
+
+def _flip_payload(path: str) -> None:
+    """Flip one byte of the shard's AMPLITUDE payload and rewrite the file
+    as a structurally valid npz. A raw byte flip at some file offset could
+    land in zip framing or the start/stop members and verify clean; this
+    manufactures exactly the failure the index CRC exists to catch -- a
+    readable shard whose payload silently differs from what was indexed."""
+    import numpy as np
+    with np.load(path) as z:
+        data = {k: z[k] for k in z.files}
+    amps = np.ascontiguousarray(data["amps"])
+    raw = bytearray(amps.tobytes())
+    raw[len(raw) // 2] ^= 0xFF
+    data["amps"] = np.frombuffer(bytes(raw), dtype=amps.dtype).reshape(
+        amps.shape)
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **data)
+
+
+def segment_boundary(cursor: int, checkpoint_dir: str) -> None:
+    """Visit the inter-segment preemption site; raises
+    :class:`~quest_tpu.resilience.errors.QuESTPreemptionError` carrying
+    the resume cursor when the plan preempts here."""
+    if not faultinject.enabled():
+        return
+    kind = faultinject.fire("segment.boundary")
+    if kind == "preempt":
+        from .errors import QuESTPreemptionError
+        raise QuESTPreemptionError(
+            f"injected preemption after checkpoint at tape cursor {cursor}"
+            f" (resume from {checkpoint_dir!r})", "run_segmented",
+            cursor=cursor, checkpoint_dir=checkpoint_dir)

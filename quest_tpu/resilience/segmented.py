@@ -1,0 +1,258 @@
+"""Preemption-safe segmented execution with verified checkpoints.
+
+A fused :class:`~quest_tpu.fusion.FusePlan` tape is not interruptible at
+arbitrary points: between a PallasRun's folded load swap and its store
+swap the amplitudes live in a PERMUTED frame, and a snapshot taken there
+is not a state the public API can name. The points where the frame
+returns to identity -- exactly what ``analysis/plancheck`` (QT102/QT103)
+proves exist before every non-plan item and at plan end -- are the legal
+segment boundaries. :func:`segment_plan` recomputes them here by symbolic
+frame replay of the tape's swap blocks (the same bit-block composition
+plancheck walks).
+
+:func:`run_segmented` executes the tape segment by segment; at each
+selected boundary it writes one checkpoint GENERATION: a full
+:func:`~quest_tpu.checkpoint.saveQureg` snapshot (amplitudes + env seeds
++ MT19937 RNG cursor, per-shard CRC32 in the index) plus a
+``segment.json`` manifest recording the tape cursor and the circuit
+fingerprint. Generations are retained ``keep`` deep; the preemption
+fault-injection site (``segment.boundary:preempt``) fires BETWEEN
+segments, after the checkpoint is durable.
+
+:func:`resume_segmented` walks generations newest-first, picks the last
+one that passes :func:`~quest_tpu.checkpoint.verify_snapshot` (rejected
+generations are flight-recorded QT305 and skipped -- a torn or
+bit-flipped shard falls back to the previous generation instead of
+failing the resume), reloads the register and RNG, and replays the
+remaining segments. Segment executables are deterministic functions of
+the tape slice, and snapshot round-trips are exact, so an interrupted +
+resumed run is bit-identical to an uninterrupted segmented run -- the
+property tests/test_resilience.py proves on the 8-device mesh for both
+the f32 and the double-float route.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+from .. import telemetry
+from ..validation import QuESTError
+from . import guard
+
+__all__ = ["segment_plan", "run_segmented", "resume_segmented"]
+
+_MANIFEST = "segment.json"
+_GEN_PREFIX = "gen_"
+
+
+def _qt304(message: str) -> QuESTError:
+    from ..analysis.diagnostics import emit_findings, make_finding
+    emit_findings([make_finding("QT304", message, "resilience.segmented")])
+    return QuESTError(f"{message} [QT304]", "run_segmented")
+
+
+def _qt305(gen_dir: str, why: str) -> None:
+    from ..analysis.diagnostics import emit_findings, make_finding
+    emit_findings([make_finding(
+        "QT305", f"checkpoint generation {os.path.basename(gen_dir)!r} "
+        f"failed verification ({why}); falling back to an older generation",
+        "resilience.segmented")])
+
+
+def _swap_blocks(perm: list, tile_bits: int, k: int, hi) -> None:
+    """Apply one bit-block swap (the swap_bit_blocks relabeling) to the
+    symbolic frame: exchange blocks [tile_bits-k, tile_bits) and
+    [hi or tile_bits, +k)."""
+    lo1 = tile_bits - k
+    lo2 = tile_bits if hi is None else hi
+    for j in range(k):
+        perm[lo1 + j], perm[lo2 + j] = perm[lo2 + j], perm[lo1 + j]
+
+
+def segment_plan(tape, nsv: int, every_n_items: int = 1) -> list:
+    """The selected checkpoint cuts for ``tape``: a sorted list of tape
+    indices starting at 0 and ending at ``len(tape)``, each a
+    frame-identity boundary, spaced at least ``every_n_items`` tape
+    entries apart (the next identity boundary when the exact spacing
+    lands mid-permutation)."""
+    if every_n_items < 1:
+        raise _qt304(f"every_n_items must be >= 1, got {every_n_items}")
+    perm = list(range(nsv))
+    ident = list(range(nsv))
+    boundaries = [0]
+    for i, (f, a, _kw) in enumerate(tape):
+        name = getattr(f, "__name__", "")
+        if name == "_apply_pallas_run":
+            _ops, tb, lk, sk, lh, sh = a[:6]
+            if lk:
+                _swap_blocks(perm, tb, lk, lh)
+            if sk:
+                _swap_blocks(perm, tb, sk, sh)
+        elif name == "_apply_frame_swap":
+            tb, k, hi = a
+            _swap_blocks(perm, tb, k, hi)
+        # every other entry operates in (and preserves) the identity frame
+        # -- the invariant plancheck QT102 enforces on fused plans
+        if perm == ident:
+            boundaries.append(i + 1)
+    if boundaries[-1] != len(tape):
+        raise _qt304(
+            "tape does not return to the identity frame at its end "
+            "(plancheck QT103 would reject this plan)")
+    cuts = [0]
+    for b in boundaries[1:]:
+        if b - cuts[-1] >= every_n_items:
+            cuts.append(b)
+    if cuts[-1] != len(tape):
+        cuts.append(len(tape))
+    return cuts
+
+
+def _as_qureg(circuit, target):
+    from ..environment import QuESTEnv
+    from ..registers import Qureg, createDensityQureg, createQureg
+
+    if isinstance(target, Qureg):
+        return target
+    if isinstance(target, QuESTEnv):
+        make = (createDensityQureg if circuit.is_density_matrix
+                else createQureg)
+        return make(circuit.num_qubits, target)
+    raise QuESTError(
+        f"run_segmented needs a QuESTEnv or Qureg, got {type(target)!r}",
+        "run_segmented")
+
+
+def _gen_dirs(checkpoint_dir: str) -> list:
+    """Existing generation dirs sorted ascending by tape cursor."""
+    out = []
+    if not os.path.isdir(checkpoint_dir):
+        return out
+    for name in os.listdir(checkpoint_dir):
+        if name.startswith(_GEN_PREFIX):
+            try:
+                cursor = int(name[len(_GEN_PREFIX):])
+            except ValueError:
+                continue
+            out.append((cursor, os.path.join(checkpoint_dir, name)))
+    return [p for _, p in sorted(out)]
+
+
+def _checkpoint(circuit, qureg, checkpoint_dir: str, cursor: int,
+                every_n_items: int, keep: int) -> str:
+    from ..checkpoint import saveQureg
+
+    gen = os.path.join(checkpoint_dir, f"{_GEN_PREFIX}{cursor:08d}")
+    saveQureg(qureg, gen)
+    manifest = {"cursor": cursor, "total_items": len(circuit._tape),
+                "fingerprint": circuit.fingerprint(),
+                "every_n_items": every_n_items}
+    tmp = os.path.join(gen, _MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(gen, _MANIFEST))
+    telemetry.inc("segmented_checkpoints_total")
+    gens = _gen_dirs(checkpoint_dir)
+    for stale in gens[:-keep] if keep > 0 else []:
+        shutil.rmtree(stale, ignore_errors=True)
+    return gen
+
+
+def _execute(circuit, qureg, cuts, start: int, checkpoint_dir: str,
+             every_n_items: int, keep: int):
+    from ..circuits import Circuit
+
+    for lo, hi in zip(cuts, cuts[1:]):
+        if hi <= start:
+            continue
+        seg = Circuit(circuit.num_qubits, circuit.is_density_matrix)
+        seg._tape = list(circuit._tape[lo:hi])
+        with telemetry.span("segmented.segment", lo=lo, hi=hi):
+            seg.run(qureg)
+        telemetry.inc("segmented_segments_total")
+        _checkpoint(circuit, qureg, checkpoint_dir, hi, every_n_items, keep)
+        if hi < cuts[-1]:
+            # the injectable preemption point: the checkpoint above is
+            # durable, so a preemption here resumes from cursor == hi
+            guard.segment_boundary(hi, checkpoint_dir)
+    return qureg
+
+
+def run_segmented(circuit, target, *, checkpoint_dir: str,
+                  every_n_items: int = 1, keep: int = 2):
+    """Execute ``circuit`` segment by segment (see module docstring).
+
+    ``target`` is a :class:`~quest_tpu.environment.QuESTEnv` (a fresh
+    |0...0> register is created over it) or an existing
+    :class:`~quest_tpu.registers.Qureg`. Returns the final register; the
+    last generation under ``checkpoint_dir`` holds the completed state
+    (cursor == len(tape))."""
+    if keep < 1:
+        raise _qt304(f"keep must be >= 1, got {keep}")
+    qureg = _as_qureg(circuit, target)
+    nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
+    cuts = segment_plan(circuit._tape, nsv, every_n_items)
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    telemetry.event("segmented.run", segments=len(cuts) - 1,
+                    items=len(circuit._tape))
+    return _execute(circuit, qureg, cuts, 0, checkpoint_dir,
+                    every_n_items, keep)
+
+
+def resume_segmented(circuit, checkpoint_dir: str, env, *,
+                     every_n_items: int | None = None, keep: int = 2):
+    """Restart a :func:`run_segmented` execution from the last VERIFIED
+    generation under ``checkpoint_dir`` (see module docstring), replaying
+    the remaining segments; returns the final register. ``every_n_items``
+    defaults to the value recorded in the manifest, so resumed
+    checkpointing continues on the original cadence."""
+    gens = _gen_dirs(checkpoint_dir)
+    if not gens:
+        raise QuESTError(
+            f"no checkpoint generations under {checkpoint_dir!r}",
+            "resume_segmented")
+    from ..checkpoint import loadQureg, verify_snapshot
+
+    chosen = manifest = None
+    for gen in reversed(gens):
+        mpath = os.path.join(gen, _MANIFEST)
+        try:
+            with open(mpath) as f:
+                m = json.load(f)
+            verify_snapshot(gen)
+        except (OSError, ValueError, QuESTError) as e:
+            _qt305(gen, str(e))
+            telemetry.inc("segmented_resume_total", outcome="rejected_gen")
+            continue
+        if m.get("fingerprint") != circuit.fingerprint():
+            raise QuESTError(
+                f"checkpoint generation {os.path.basename(gen)!r} belongs "
+                f"to a different circuit (fingerprint mismatch)",
+                "resume_segmented")
+        chosen, manifest = gen, m
+        break
+    if chosen is None:
+        telemetry.inc("segmented_resume_total", outcome="no_verified_gen")
+        raise QuESTError(
+            f"no generation under {checkpoint_dir!r} passed verification",
+            "resume_segmented")
+
+    qureg = loadQureg(chosen, env)
+    cursor = int(manifest["cursor"])
+    n_items = (int(manifest.get("every_n_items", 1))
+               if every_n_items is None else every_n_items)
+    telemetry.inc("segmented_resume_total", outcome="verified")
+    telemetry.event("segmented.resume", cursor=cursor,
+                    generation=os.path.basename(chosen))
+    if cursor >= len(circuit._tape):
+        return qureg
+    nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
+    cuts = segment_plan(circuit._tape, nsv, n_items)
+    if cursor not in cuts:
+        raise QuESTError(
+            f"manifest cursor {cursor} is not a segment boundary of this "
+            f"circuit at every_n_items={n_items}", "resume_segmented")
+    return _execute(circuit, qureg, cuts, cursor, checkpoint_dir,
+                    n_items, keep)

@@ -475,6 +475,47 @@ def test_engine_close_drains_and_rejects():
         eng.submit(PARAMS)
 
 
+def test_engine_close_nodrain_resolves_blocked_waiters():
+    """Regression: close(drain=False) used to drop queued requests with
+    their futures forever pending, deadlocking any thread blocked in
+    result(). Every undispatched future must resolve with the typed
+    cancellation error instead."""
+    import threading
+
+    from quest_tpu.resilience import QuESTCancelledError
+
+    import time
+
+    _, cp = _pair()
+    eng = Engine(cp, ENV1, max_batch=1, max_delay_ms=0.0)
+    gate = threading.Event()
+    orig = eng._dispatch
+    eng._dispatch = lambda b: (gate.wait(10), orig(b))
+    futs = eng.submit_many(_sweep(4, np.random.RandomState(3)))
+    waited = {}
+
+    def waiter():
+        try:
+            waited["out"] = futs[-1].result(timeout=30)
+        except BaseException as e:  # noqa: BLE001 - recorded for assert
+            waited["out"] = e
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)  # the loop is now blocked dispatching request 0
+    # release the in-flight dispatch only after close() has started, so
+    # requests 1..3 are provably still queued when the close decision lands
+    threading.Timer(0.2, gate.set).start()
+    eng.close(drain=False)
+    t.join(timeout=30)
+    assert not t.is_alive(), "waiter deadlocked on an unresolved future"
+    assert all(f.done() for f in futs)
+    assert isinstance(waited["out"], QuESTCancelledError)
+    assert futs[0].exception() is None  # in-flight work still completed
+    for f in futs[1:]:
+        assert isinstance(f.exception(), QuESTCancelledError)
+
+
 def test_engine_value_free_circuit():
     c = Circuit(3)
     c.hadamard(0)

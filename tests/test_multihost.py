@@ -23,6 +23,47 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def test_init_missing_coordinator_times_out_typed():
+    """Regression (ISSUE 7 satellite): multihost.init against an absent
+    coordinator must raise a typed QuESTError naming the applied
+    initialization_timeout (flight-recorded QT301) instead of hanging --
+    on jax 0.4.x the distributed client would otherwise FATAL-abort the
+    whole process after the jax-side deadline. The bounded pre-flight
+    probe raises before jax.distributed is ever touched, so this is safe
+    in-process."""
+    from quest_tpu import telemetry
+    from quest_tpu.parallel import multihost
+    from quest_tpu.validation import QuESTError
+
+    port = _free_port()  # bound then released: nothing listens on it
+    telemetry.reset()
+    with pytest.raises(QuESTError) as ei:
+        multihost.init(f"127.0.0.1:{port}", num_processes=2,
+                       process_id=1, initialization_timeout=1)
+    msg = str(ei.value)
+    assert "QT301" in msg
+    assert "1s initialization_timeout" in msg
+    assert telemetry.counter_value("analysis_findings_total",
+                                   code="QT301", severity="error") == 1
+    with pytest.raises(QuESTError, match="host:port"):
+        multihost.init("nonsense", num_processes=2, process_id=1,
+                       initialization_timeout=1)
+
+
+def test_resolve_timeout_env_knob(monkeypatch):
+    from quest_tpu import telemetry
+    from quest_tpu.parallel.multihost import _DEF_TIMEOUT_S, _resolve_timeout
+
+    assert _resolve_timeout(17.0) == 17.0
+    monkeypatch.setenv("QUEST_INIT_TIMEOUT_S", "42")
+    assert _resolve_timeout(None) == 42.0
+    telemetry.reset()
+    monkeypatch.setenv("QUEST_INIT_TIMEOUT_S", "soon")
+    assert _resolve_timeout(None) == _DEF_TIMEOUT_S
+    assert telemetry.counter_value("analysis_findings_total",
+                                   code="QT303", severity="warning") == 1
+
+
 @pytest.mark.slow
 def test_two_process_distributed_smoke(tmp_path):
     port = _free_port()

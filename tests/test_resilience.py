@@ -1,0 +1,505 @@
+"""Resilience layer (ISSUE 7): fault injection, retry/backoff, poisoned-
+request isolation, and preemption-safe segmented execution.
+
+Contracts under test, mirroring the failure-mode table in
+docs/resilience.md:
+
+- with ``QUEST_FAULTS`` unset every injection site is a no-op: zero new
+  ``engine_fallback_total`` entries, zero retry series;
+- a transient Pallas/collective fault retries and the recovered run is
+  BIT-IDENTICAL to the clean run; a compile fault degrades along the
+  existing fallback lattice (``engine_fallback_total{reason=
+  fault_degraded}``) and matches the eager oracle;
+- a poisoned request in a batch is isolated by bisection: its future
+  fails typed, its neighbors complete bit-identically to solo replays;
+- request deadlines and the bounded queue fail closed with
+  QuESTTimeoutError / QuESTBackpressureError;
+- a segmented run checkpoints at frame-identity boundaries, and an
+  injected mid-plan preemption + resume is bit-identical to the
+  uninterrupted run (8-device mesh, f32 and double-float routes);
+- resume rejects corrupt generations (QT305) and falls back to the
+  previous verified one.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import quest_tpu as qt
+from quest_tpu import telemetry
+from quest_tpu.circuits import Circuit
+from quest_tpu.resilience import (
+    FaultPlan, QuESTBackpressureError, QuESTPreemptionError, QuESTRetryError,
+    QuESTTimeoutError, RetryPolicy, call_with_retry, fault_plan, faultinject,
+    resume_segmented, segment_plan,
+)
+from quest_tpu.resilience.errors import (
+    KernelCompileFault, PoisonedRequestFault, TransientFault,
+)
+from quest_tpu.validation import QuESTError
+
+ENV = qt.createQuESTEnv(jax.devices()[:1])
+ENV8 = qt.createQuESTEnv(jax.devices()[:8])
+
+
+def _ghz_plus(n):
+    c = Circuit(n)
+    for q in range(n):
+        c.hadamard(q)
+    for q in range(n - 1):
+        c.controlledNot(q, q + 1)
+    for q in range(n):
+        c.tGate(q)
+        c.rotateZ(q, 0.1 + 0.05 * q)
+    return c
+
+
+# -- fault-plan parsing and the disabled path -------------------------------
+
+def test_fault_plan_parse_nth_and_from_on():
+    p = FaultPlan.parse("pallas.dispatch:transient:2,"
+                        "exchange.collective:transient:1+")
+    assert len(p.specs) == 2
+    s0, s1 = p.specs
+    assert (s0.site, s0.kind, s0.nth, s0.from_nth_on) == \
+        ("pallas.dispatch", "transient", 2, False)
+    assert s1.from_nth_on and s1.nth == 1
+    assert not s0.matches(1) and s0.matches(2) and not s0.matches(3)
+    assert s1.matches(1) and s1.matches(7)
+
+
+def test_fault_plan_malformed_entries_skipped_with_qt302():
+    telemetry.reset()
+    p = FaultPlan.parse("nosite:transient:1,pallas.dispatch:nokind:1,"
+                        "pallas.dispatch:transient:0,short,"
+                        "engine.request:poison:3")
+    assert len(p.specs) == 1  # only the last entry is valid
+    assert telemetry.counter_value("analysis_findings_total",
+                                   code="QT302", severity="warning") == 4
+    with pytest.raises(QuESTError, match="QT302"):
+        FaultPlan.parse("nosite:transient:1", strict=True)
+
+
+def test_fault_plan_visit_counting_is_deterministic():
+    with fault_plan("engine.request:poison:2") as plan:
+        assert faultinject.fire("engine.request") is None
+        assert faultinject.fire("engine.request") == "poison"
+        assert faultinject.fire("engine.request") is None
+        assert plan.visits("engine.request") == 3
+    # context exit restores the disabled state
+    assert faultinject.fire("engine.request") is None
+
+
+def test_env_var_plan_loads_once(monkeypatch):
+    monkeypatch.setattr(faultinject, "_active", None)
+    monkeypatch.setattr(faultinject, "_env_read", False)
+    monkeypatch.setenv("QUEST_FAULTS", "segment.boundary:preempt:1")
+    assert faultinject.enabled()
+    plan = faultinject.active_plan()
+    assert plan.specs[0].site == "segment.boundary"
+    faultinject.clear()
+    assert not faultinject.enabled()
+
+
+def test_disabled_sites_are_noops_and_add_zero_fallbacks():
+    faultinject.clear()
+    telemetry.reset()
+    c = _ghz_plus(8).fused(max_qubits=4, pallas=True)
+    q = qt.createQureg(8, ENV)
+    c.run(q)
+    with qt.explicit_mesh(ENV8.mesh):
+        qe = qt.createQureg(5, ENV8)
+        qt.hadamard(qe, 4)
+    assert telemetry.counters("retry_attempts_total") == {}
+    assert telemetry.counters("fault_injected_total") == {}
+    assert telemetry.counter_value("engine_fallback_total",
+                                   reason="fault_degraded") == 0
+
+
+# -- retry policy -----------------------------------------------------------
+
+def test_retry_schedule_is_deterministic_and_capped():
+    pol = RetryPolicy(max_attempts=5, base_delay_s=0.004, multiplier=2.0,
+                      max_delay_s=0.01, seed=7)
+    a, b = list(pol.delays()), list(pol.delays())
+    assert a == b and len(a) == 4
+    assert all(0.002 <= d <= 0.01 for d in a)
+    assert list(RetryPolicy(max_attempts=5, seed=8).delays()) != \
+        list(RetryPolicy(max_attempts=5, seed=7).delays())
+
+
+def test_call_with_retry_outcomes_and_exhaustion():
+    telemetry.reset()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientFault("x", "transient")
+        return 42
+
+    pol = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+    assert call_with_retry(flaky, site="x", policy=pol,
+                           sleep=lambda _d: None) == 42
+    assert telemetry.counter_value("retry_attempts_total", site="x",
+                                   outcome="retried") == 2
+    assert telemetry.counter_value("retry_attempts_total", site="x",
+                                   outcome="ok") == 1
+
+    def always():
+        raise TransientFault("y", "transient")
+
+    with pytest.raises(TransientFault):
+        call_with_retry(always, site="y", policy=pol, sleep=lambda _d: None)
+    assert telemetry.counter_value("retry_attempts_total", site="y",
+                                   outcome="exhausted") == 1
+
+
+def test_call_with_retry_deadline_stops_early():
+    telemetry.reset()
+    t = {"now": 0.0}
+
+    def always():
+        t["now"] += 1.0  # each attempt burns fake time past the deadline
+        raise TransientFault("z", "transient")
+
+    pol = RetryPolicy(max_attempts=10, base_delay_s=0.0, deadline_s=0.5)
+    real = time.monotonic
+    time.monotonic = lambda: t["now"]
+    try:
+        with pytest.raises(TransientFault):
+            call_with_retry(always, site="z", policy=pol,
+                            sleep=lambda _d: None)
+    finally:
+        time.monotonic = real
+    assert telemetry.counter_value("retry_attempts_total", site="z",
+                                   outcome="exhausted") == 1
+    assert telemetry.counter_value("retry_attempts_total", site="z",
+                                   outcome="retried") == 0
+
+
+def test_default_policy_env_knobs(monkeypatch):
+    from quest_tpu.resilience.retry import default_policy
+    monkeypatch.setenv("QUEST_RETRY_MAX", "5")
+    monkeypatch.setenv("QUEST_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("QUEST_RETRY_DEADLINE_MS", "250")
+    pol = default_policy()
+    assert pol.max_attempts == 5
+    assert pol.base_delay_s == pytest.approx(0.001)
+    assert pol.deadline_s == pytest.approx(0.25)
+    telemetry.reset()
+    monkeypatch.setenv("QUEST_RETRY_MAX", "banana")
+    assert default_policy().max_attempts == 3
+    assert telemetry.counter_value("analysis_findings_total",
+                                   code="QT303", severity="warning") == 1
+
+
+# -- pallas.dispatch faults -------------------------------------------------
+
+def test_pallas_transient_retries_bit_identical():
+    fz = _ghz_plus(8).fused(max_qubits=4, pallas=True)
+    q0 = qt.createQureg(8, ENV)
+    fz.run(q0)
+    want = np.asarray(q0.amps)
+
+    telemetry.reset()
+    with fault_plan("pallas.dispatch:transient:1"):
+        fz1 = _ghz_plus(8).fused(max_qubits=4, pallas=True)  # fresh trace
+        q1 = qt.createQureg(8, ENV)
+        fz1.run(q1)
+    assert np.array_equal(want, np.asarray(q1.amps))
+    assert telemetry.counter_value("fault_injected_total",
+                                   site="pallas.dispatch",
+                                   kind="transient") == 1
+    assert telemetry.counter_value("retry_attempts_total",
+                                   site="pallas.dispatch",
+                                   outcome="retried") == 1
+    assert telemetry.counter_value("engine_fallback_total",
+                                   reason="fault_degraded") == 0
+
+
+def test_pallas_compile_fault_degrades_matching_oracle():
+    oracle = qt.createQureg(8, ENV)
+    _ghz_plus(8).run(oracle)
+    telemetry.reset()
+    with fault_plan("pallas.dispatch:compile:1+"):
+        fz = _ghz_plus(8).fused(max_qubits=4, pallas=True)
+        q = qt.createQureg(8, ENV)
+        fz.run(q)
+    np.testing.assert_allclose(np.asarray(q.amps), np.asarray(oracle.amps),
+                               atol=1e-12)
+    assert telemetry.counter_value("engine_fallback_total",
+                                   reason="fault_degraded") >= 1
+    assert telemetry.counter_value("fault_injected_total",
+                                   site="pallas.dispatch", kind="compile") >= 1
+
+
+def test_pallas_sharded_transient_retries_bit_identical():
+    fz = _ghz_plus(10).fused(max_qubits=5, pallas=True, shard_devices=8)
+    q0 = qt.createQureg(10, ENV8)
+    fz.run(q0)
+    want = np.asarray(q0.amps)
+    with fault_plan("pallas.dispatch:transient:1"):
+        fz1 = _ghz_plus(10).fused(max_qubits=5, pallas=True, shard_devices=8)
+        q1 = qt.createQureg(10, ENV8)
+        fz1.run(q1)
+    assert np.array_equal(want, np.asarray(q1.amps))
+
+
+# -- exchange.collective faults ---------------------------------------------
+
+def test_collective_transient_retries_bit_identical():
+    with qt.explicit_mesh(ENV8.mesh):
+        q0 = qt.createQureg(5, ENV8)
+        qt.hadamard(q0, 4)
+    want = np.asarray(q0.amps)
+    telemetry.reset()
+    with fault_plan("exchange.collective:transient:1"):
+        with qt.explicit_mesh(ENV8.mesh):
+            q1 = qt.createQureg(5, ENV8)
+            qt.hadamard(q1, 4)
+    assert np.array_equal(want, np.asarray(q1.amps))
+    assert telemetry.counter_value("retry_attempts_total",
+                                   site="exchange.collective",
+                                   outcome="ok") == 1
+
+
+def test_collective_exhaustion_fails_closed():
+    telemetry.reset()
+    with fault_plan("exchange.collective:transient:1+"):
+        with pytest.raises(QuESTRetryError):
+            with qt.explicit_mesh(ENV8.mesh):
+                q = qt.createQureg(5, ENV8)
+                qt.hadamard(q, 4)
+    assert telemetry.counter_value("retry_attempts_total",
+                                   site="exchange.collective",
+                                   outcome="exhausted") == 1
+
+
+# -- engine hardening -------------------------------------------------------
+
+def _param_circuit(n=3):
+    c = Circuit(n)
+    c.hadamard(0)
+    c.controlledNot(0, 1)
+    c.rotateX(n - 1, qt.P("t"))
+    return c
+
+
+def test_engine_poisoned_request_isolated_by_bisection():
+    c = _param_circuit()
+    telemetry.reset()
+    with fault_plan("engine.request:poison:2"):
+        eng = qt.Engine(c, ENV, max_batch=4)
+        futs = [eng.submit({"t": 0.1 * i}) for i in range(4)]
+        results = []
+        for f in futs:
+            try:
+                results.append(np.asarray(f.result(timeout=120)))
+            except PoisonedRequestFault as e:
+                results.append(e)
+        eng.close()
+    assert isinstance(results[1], PoisonedRequestFault)
+    exe = c.parameterized(donate=False)
+    for i in (0, 2, 3):
+        q = qt.createQureg(3, ENV)
+        want = np.asarray(exe(q.amps, {"t": 0.1 * i}))
+        assert np.array_equal(want, results[i]), f"lane {i} diverged"
+    assert telemetry.counter_value("engine_bisections_total") >= 1
+    assert telemetry.counter_value("engine_poisoned_requests_total") == 1
+
+
+def test_engine_request_timeout_queued_past_deadline():
+    c = _param_circuit()
+    eng = qt.Engine(c, ENV, max_batch=1)
+    gate = threading.Event()
+    orig = eng._dispatch
+    eng._dispatch = lambda b: (gate.wait(5), orig(b))
+    try:
+        f1 = eng.submit({"t": 0.1})           # occupies the dispatch loop
+        time.sleep(0.05)
+        f2 = eng.submit({"t": 0.2}, timeout=0.01)   # expires while queued
+        gate.set()
+        with pytest.raises(QuESTTimeoutError):
+            f2.result(timeout=60)
+        assert f1.result(timeout=60) is not None
+    finally:
+        gate.set()
+        eng.close()
+    assert telemetry.counter_value("engine_request_timeouts_total") >= 1
+    with pytest.raises(ValueError):
+        qt.Engine(_param_circuit(), ENV).submit({"t": 1.0}, timeout=-1)
+
+
+def test_engine_backpressure_bounded_queue():
+    c = _param_circuit()
+    eng = qt.Engine(c, ENV, max_batch=1, queue_max=1)
+    assert eng.queue_max == 1
+    gate = threading.Event()
+    orig = eng._dispatch
+    eng._dispatch = lambda b: (gate.wait(5), orig(b))
+    try:
+        eng.submit({"t": 0.1})
+        time.sleep(0.05)  # let the loop pop the first request
+        with pytest.raises(QuESTBackpressureError):
+            eng.submit({"t": 0.2})
+            eng.submit({"t": 0.3})
+    finally:
+        gate.set()
+        eng.close()
+    assert telemetry.counter_value("engine_backpressure_total") >= 1
+
+
+def test_engine_queue_max_env_knob(monkeypatch):
+    monkeypatch.setenv("QUEST_ENGINE_QUEUE_MAX", "7")
+    eng = qt.Engine(_param_circuit(), ENV)
+    assert eng.queue_max == 7
+    eng.close()
+    telemetry.reset()
+    monkeypatch.setenv("QUEST_ENGINE_QUEUE_MAX", "lots")
+    eng = qt.Engine(_param_circuit(), ENV)
+    assert eng.queue_max == 0  # malformed -> unbounded, flight-recorded
+    eng.close()
+    assert telemetry.counter_value("analysis_findings_total",
+                                   code="QT303", severity="warning") == 1
+
+
+# -- segmented execution ----------------------------------------------------
+
+def test_segment_plan_identity_boundaries():
+    fz = _ghz_plus(8).fused(max_qubits=4, pallas=True)
+    cuts = segment_plan(fz._tape, 8, every_n_items=1)
+    assert cuts[0] == 0 and cuts[-1] == len(fz._tape)
+    assert cuts == sorted(set(cuts))
+    sparse = segment_plan(fz._tape, 8, every_n_items=3)
+    assert sparse[0] == 0 and sparse[-1] == len(fz._tape)
+    assert all(b - a >= 3 for a, b in zip(sparse, sparse[1:-1]))
+    assert set(sparse) <= set(cuts)
+    with pytest.raises(QuESTError, match="QT304"):
+        segment_plan(fz._tape, 8, every_n_items=0)
+
+
+def test_run_segmented_matches_plain_run(tmp_path):
+    c = _ghz_plus(6)
+    ref = qt.createQureg(6, ENV)
+    c.run(ref)
+    out = c.run_segmented(ENV, checkpoint_dir=str(tmp_path / "seg"),
+                          every_n_items=4)
+    assert np.array_equal(np.asarray(ref.amps), np.asarray(out.amps))
+    with pytest.raises(QuESTError, match="QT304"):
+        c.run_segmented(ENV, checkpoint_dir=str(tmp_path / "k0"), keep=0)
+
+
+@pytest.mark.parametrize("route", ["f32", "df"])
+def test_preempt_resume_bit_identical_sharded(tmp_path, route, monkeypatch):
+    """The acceptance proof: a mid-plan preemption on the 8-device mesh
+    resumes from the last verified generation and finishes bit-identical
+    to the uninterrupted run, on both the f32 and double-float routes."""
+    if route == "df":
+        monkeypatch.setenv("QUEST_PALLAS_DF", "1")
+        code = 2
+    else:
+        code = 1
+    c = _ghz_plus(10).fused(max_qubits=5, pallas=True, shard_devices=8)
+
+    q_ref = qt.createQureg(10, ENV8, precision_code=code)
+    c.run(q_ref)
+    want = np.asarray(q_ref.amps)
+
+    d = str(tmp_path / route)
+    q0 = qt.createQureg(10, ENV8, precision_code=code)
+    telemetry.reset()
+    with fault_plan("segment.boundary:preempt:1"):
+        with pytest.raises(QuESTPreemptionError) as ei:
+            c.run_segmented(q0, checkpoint_dir=d, every_n_items=1)
+    assert ei.value.cursor is not None and ei.value.checkpoint_dir == d
+
+    env2 = qt.createQuESTEnv(jax.devices()[:8])
+    out = resume_segmented(c, d, env2)
+    assert np.asarray(out.amps).dtype == want.dtype
+    assert np.array_equal(want, np.asarray(out.amps))
+    assert telemetry.counter_value("segmented_resume_total",
+                                   outcome="verified") == 1
+    assert telemetry.counter_value("segmented_checkpoints_total") >= 1
+
+
+def test_resume_skips_corrupt_generation_qt305(tmp_path):
+    c = _ghz_plus(6)
+    ref = qt.createQureg(6, ENV)
+    c.run(ref)
+    want = np.asarray(ref.amps)
+
+    d = str(tmp_path / "seg")
+    with fault_plan("segment.boundary:preempt:2"):
+        with pytest.raises(QuESTPreemptionError):
+            c.run_segmented(ENV, checkpoint_dir=d, every_n_items=1, keep=3)
+    gens = sorted(g for g in os.listdir(d) if g.startswith("gen_"))
+    assert len(gens) >= 2
+    # bit-flip the newest generation's shard payload: resume must reject it
+    # (QT305), fall back to the previous generation, and still finish
+    newest = os.path.join(d, gens[-1])
+    shard = [f for f in os.listdir(newest) if f.startswith("amps.shard_")][0]
+    from quest_tpu.resilience.guard import _flip_payload
+    _flip_payload(os.path.join(newest, shard))
+
+    telemetry.reset()
+    out = resume_segmented(c, d, qt.createQuESTEnv(jax.devices()[:1]))
+    assert np.array_equal(want, np.asarray(out.amps))
+    assert telemetry.counter_value("segmented_resume_total",
+                                   outcome="rejected_gen") == 1
+    assert telemetry.counter_value("analysis_findings_total",
+                                   code="QT305", severity="warning") == 1
+
+
+def test_resume_all_generations_corrupt_fails_closed(tmp_path):
+    c = _ghz_plus(5)
+    d = str(tmp_path / "seg")
+    with fault_plan("segment.boundary:preempt:1"):
+        with pytest.raises(QuESTPreemptionError):
+            c.run_segmented(ENV, checkpoint_dir=d, every_n_items=1, keep=1)
+    for gen in os.listdir(d):
+        for f in os.listdir(os.path.join(d, gen)):
+            if f.startswith("amps.shard_"):
+                with open(os.path.join(d, gen, f), "wb") as fh:
+                    fh.write(b"PK\x03\x04 torn")
+    telemetry.reset()
+    with pytest.raises(QuESTError, match="passed verification"):
+        resume_segmented(c, d, ENV)
+    assert telemetry.counter_value("segmented_resume_total",
+                                   outcome="no_verified_gen") == 1
+
+
+def test_resume_fingerprint_mismatch_raises(tmp_path):
+    c = _ghz_plus(5)
+    d = str(tmp_path / "seg")
+    c.run_segmented(ENV, checkpoint_dir=d, every_n_items=2)
+    other = _ghz_plus(5)
+    other.hadamard(0)
+    with pytest.raises(QuESTError, match="fingerprint"):
+        resume_segmented(other, d, ENV)
+    with pytest.raises(QuESTError, match="no checkpoint generations"):
+        resume_segmented(c, str(tmp_path / "empty"), ENV)
+
+
+def test_segmented_retention_keeps_last_k(tmp_path):
+    c = _ghz_plus(6)
+    d = str(tmp_path / "seg")
+    c.run_segmented(ENV, checkpoint_dir=d, every_n_items=1, keep=2)
+    gens = sorted(g for g in os.listdir(d) if g.startswith("gen_"))
+    assert len(gens) == 2
+    assert int(gens[-1][len("gen_"):]) == len(c._tape)
+
+
+def test_resume_of_completed_run_is_loadable(tmp_path):
+    c = _ghz_plus(5)
+    ref = qt.createQureg(5, ENV)
+    c.run(ref)
+    d = str(tmp_path / "seg")
+    c.run_segmented(ENV, checkpoint_dir=d, every_n_items=2)
+    out = resume_segmented(c, d, qt.createQuESTEnv(jax.devices()[:1]))
+    assert np.array_equal(np.asarray(ref.amps), np.asarray(out.amps))

@@ -1,0 +1,278 @@
+"""Chaos scenario suite for the resilience layer (ISSUE 7 satellite).
+
+Each scenario arms one fault class through ``quest_tpu.resilience``'s
+injection plan, runs a real circuit through the hardened path, and
+asserts BOTH the recovery behavior (retry / degrade / isolate / resume)
+and the final-state contract (bit-identity to the clean run, or
+allclose-to-oracle where the degrade lattice legitimately changes the
+compute order). This is the executable form of the failure-mode table in
+docs/resilience.md, run in CI next to the bench smoke.
+
+Usage:  python tools/chaos.py [--json]
+Prints one line per scenario plus a JSON summary; exits nonzero if any
+scenario fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# an 8-device CPU mesh, pinned BEFORE jax import (tools/df_verify.py idiom)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+SCENARIOS = []
+
+
+def scenario(fn):
+    SCENARIOS.append(fn)
+    return fn
+
+
+def _ghz_plus(n):
+    from quest_tpu.circuits import Circuit
+    c = Circuit(n)
+    for q in range(n):
+        c.hadamard(q)
+    for q in range(n - 1):
+        c.controlledNot(q, q + 1)
+    for q in range(n):
+        c.tGate(q)
+        c.rotateZ(q, 0.1 + 0.05 * q)
+    return c
+
+
+def _checksum(amps) -> str:
+    import zlib
+    return f"{zlib.crc32(np.ascontiguousarray(np.asarray(amps)).tobytes()):08x}"
+
+
+@scenario
+def pallas_transient_retry(env, env8):
+    """A transient dispatch fault retries; the recovered run is
+    bit-identical to the clean fused run."""
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.resilience import fault_plan
+
+    clean = _ghz_plus(8).fused(max_qubits=4, pallas=True)
+    q0 = qt.createQureg(8, env)
+    clean.run(q0)
+    want = np.asarray(q0.amps)
+    telemetry.reset()
+    with fault_plan("pallas.dispatch:transient:1"):
+        fz = _ghz_plus(8).fused(max_qubits=4, pallas=True)
+        q1 = qt.createQureg(8, env)
+        fz.run(q1)
+    assert np.array_equal(want, np.asarray(q1.amps)), "recovered run diverged"
+    assert telemetry.counter_value("retry_attempts_total",
+                                   site="pallas.dispatch",
+                                   outcome="retried") == 1
+    return {"checksum": _checksum(q1.amps), "bit_identical": True}
+
+
+@scenario
+def pallas_compile_degrade(env, env8):
+    """A persistent compile fault degrades along the existing fallback
+    lattice and still matches the eager oracle."""
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.resilience import fault_plan
+
+    oracle = qt.createQureg(8, env)
+    _ghz_plus(8).run(oracle)
+    telemetry.reset()
+    with fault_plan("pallas.dispatch:compile:1+"):
+        fz = _ghz_plus(8).fused(max_qubits=4, pallas=True)
+        q = qt.createQureg(8, env)
+        fz.run(q)
+    # degrade changes the compute order, so allclose at the register's
+    # native precision (f32 unless QUEST_PRECISION=2), not bit-identity
+    atol = 1e-12 if np.asarray(q.amps).dtype == np.float64 else 1e-6
+    np.testing.assert_allclose(np.asarray(q.amps), np.asarray(oracle.amps),
+                               rtol=0, atol=atol)
+    degraded = telemetry.counter_value("engine_fallback_total",
+                                       reason="fault_degraded")
+    assert degraded >= 1, "degrade lattice never engaged"
+    return {"checksum": _checksum(q.amps), "degraded_runs": int(degraded)}
+
+
+@scenario
+def collective_transient_retry(env, env8):
+    """A transient collective fault on a sharded-qubit gate retries to a
+    bit-identical state; a persistent one fails closed (QuESTRetryError)."""
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.resilience import QuESTRetryError, fault_plan
+
+    with qt.explicit_mesh(env8.mesh):
+        q0 = qt.createQureg(5, env8)
+        qt.hadamard(q0, 4)
+    want = np.asarray(q0.amps)
+    telemetry.reset()
+    with fault_plan("exchange.collective:transient:1"):
+        with qt.explicit_mesh(env8.mesh):
+            q1 = qt.createQureg(5, env8)
+            qt.hadamard(q1, 4)
+    assert np.array_equal(want, np.asarray(q1.amps)), "recovered run diverged"
+    failed_closed = False
+    with fault_plan("exchange.collective:transient:1+"):
+        try:
+            with qt.explicit_mesh(env8.mesh):
+                q2 = qt.createQureg(5, env8)
+                qt.hadamard(q2, 4)
+        except QuESTRetryError:
+            failed_closed = True
+    assert failed_closed, "exhausted collective retries must fail typed"
+    return {"checksum": _checksum(q1.amps), "bit_identical": True,
+            "exhaustion_failed_closed": True}
+
+
+@scenario
+def engine_poison_bisection(env, env8):
+    """One poisoned request in a batch of 4 is isolated by bisection; the
+    healthy lanes complete bit-identically to solo replays."""
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.resilience import fault_plan
+    from quest_tpu.resilience.errors import PoisonedRequestFault
+
+    c = Circuit(3)
+    c.hadamard(0)
+    c.controlledNot(0, 1)
+    c.rotateX(2, qt.P("t"))
+    telemetry.reset()
+    with fault_plan("engine.request:poison:2"):
+        eng = qt.Engine(c, env, max_batch=4)
+        futs = [eng.submit({"t": 0.1 * i}) for i in range(4)]
+        results = []
+        for f in futs:
+            try:
+                results.append(np.asarray(f.result(timeout=120)))
+            except PoisonedRequestFault as e:
+                results.append(e)
+        eng.close()
+    assert isinstance(results[1], PoisonedRequestFault), \
+        "poisoned lane did not fail typed"
+    exe = c.parameterized(donate=False)
+    for i in (0, 2, 3):
+        q = qt.createQureg(3, env)
+        want = np.asarray(exe(q.amps, {"t": 0.1 * i}))
+        assert np.array_equal(want, results[i]), f"healthy lane {i} diverged"
+    return {"poisoned_lane": 1,
+            "bisections": int(telemetry.counter_value(
+                "engine_bisections_total")),
+            "healthy_lanes_bit_identical": True}
+
+
+@scenario
+def checkpoint_corrupt_resume_fallback(env, env8):
+    """A bit-rotted newest checkpoint generation is rejected (QT305) and
+    resume falls back to the previous verified one, finishing
+    bit-identical to the uninterrupted run."""
+    import tempfile
+
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.resilience import QuESTPreemptionError, fault_plan, \
+        resume_segmented
+    from quest_tpu.resilience.guard import _flip_payload
+
+    c = _ghz_plus(6)
+    ref = qt.createQureg(6, env)
+    c.run(ref)
+    want = np.asarray(ref.amps)
+    with tempfile.TemporaryDirectory() as d:
+        with fault_plan("segment.boundary:preempt:2"):
+            try:
+                c.run_segmented(env, checkpoint_dir=d, every_n_items=1,
+                                keep=3)
+                raise AssertionError("preemption never fired")
+            except QuESTPreemptionError:
+                pass
+        gens = sorted(g for g in os.listdir(d) if g.startswith("gen_"))
+        assert len(gens) >= 2, "need two generations to prove fallback"
+        newest = os.path.join(d, gens[-1])
+        shard = [f for f in os.listdir(newest)
+                 if f.startswith("amps.shard_")][0]
+        _flip_payload(os.path.join(newest, shard))
+        telemetry.reset()
+        out = resume_segmented(c, d, env)
+        assert np.array_equal(want, np.asarray(out.amps)), \
+            "fallback resume diverged"
+        assert telemetry.counter_value("segmented_resume_total",
+                                       outcome="rejected_gen") == 1
+    return {"checksum": _checksum(out.amps), "rejected_generation": gens[-1],
+            "bit_identical": True}
+
+
+@scenario
+def preempt_resume_sharded(env, env8):
+    """The acceptance proof at chaos scale: a mid-plan preemption of a
+    fused sharded run on the 8-device mesh resumes from the last verified
+    generation, bit-identical to the uninterrupted run."""
+    import tempfile
+
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.resilience import QuESTPreemptionError, fault_plan, \
+        resume_segmented
+
+    c = _ghz_plus(10).fused(max_qubits=5, pallas=True, shard_devices=8)
+    q_ref = qt.createQureg(10, env8)
+    c.run(q_ref)
+    want = np.asarray(q_ref.amps)
+    with tempfile.TemporaryDirectory() as d:
+        telemetry.reset()
+        with fault_plan("segment.boundary:preempt:1"):
+            try:
+                c.run_segmented(qt.createQureg(10, env8), checkpoint_dir=d,
+                                every_n_items=1)
+                raise AssertionError("preemption never fired")
+            except QuESTPreemptionError as e:
+                assert e.cursor is not None and e.checkpoint_dir == d
+        out = resume_segmented(c, d, env8)
+    assert np.array_equal(want, np.asarray(out.amps)), "resumed run diverged"
+    assert telemetry.counter_value("segmented_resume_total",
+                                   outcome="verified") == 1
+    return {"checksum": _checksum(out.amps), "bit_identical": True,
+            "devices": 8}
+
+
+def main() -> int:
+    import jax
+
+    import quest_tpu as qt
+
+    env = qt.createQuESTEnv(jax.devices()[:1])
+    env8 = qt.createQuESTEnv(jax.devices()[:8])
+
+    results = []
+    failed = 0
+    for fn in SCENARIOS:
+        name = fn.__name__
+        try:
+            detail = fn(env, env8)
+            results.append({"scenario": name, "ok": True, "detail": detail})
+            print(f"PASS {name}: {detail}")
+        except Exception as e:
+            failed += 1
+            results.append({"scenario": name, "ok": False,
+                            "error": f"{type(e).__name__}: {e}"})
+            print(f"FAIL {name}: {type(e).__name__}: {e}")
+            traceback.print_exc()
+    summary = {"scenarios": results, "passed": len(SCENARIOS) - failed,
+               "failed": failed}
+    print("CHAOS_SUMMARY " + json.dumps(summary))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
