@@ -62,7 +62,8 @@ from . import engine  # noqa: F401
 from .engine import Engine, P, Param  # noqa: F401
 from . import resilience  # noqa: F401
 from .resilience import (  # noqa: F401
-    QuESTBackpressureError, QuESTCancelledError, QuESTPreemptionError,
+    QuESTBackpressureError, QuESTCancelledError, QuESTChecksumError,
+    QuESTHangError, QuESTIntegrityError, QuESTPreemptionError,
     QuESTRetryError, QuESTTimeoutError, resume_segmented,
 )
 

@@ -10,7 +10,10 @@ reports :class:`Finding` records drawn from one code catalog:
   configuration),
 - ``QT3xx`` -- resilience/runtime hardening (multihost bring-up timeout,
   fault-plan and env-knob hygiene, segmented execution and checkpoint
-  generations -- docs/resilience.md).
+  generations -- docs/resilience.md),
+- ``QT4xx`` -- online integrity sentinels and the self-healing loop
+  (norm/trace drift, per-shard checksum divergence, watchdog deadlines
+  -- :mod:`quest_tpu.resilience.sentinel`, docs/resilience.md).
 
 Each finding carries a severity (``error`` | ``warning`` | ``info``), a
 human-readable location and a one-line fix hint. :func:`emit_findings`
@@ -123,6 +126,32 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "the generation was skipped and resume fell back to an "
               "older verified snapshot; investigate the named shard for "
               "torn writes or corruption"),
+    # -- QT4xx: integrity sentinels / self-healing (docs/resilience.md) -----
+    "QT401": ("error", "total-probability drift beyond the precision "
+                       "tolerance band",
+              "the register's norm (or density trace) left the f32/df "
+              "band: silent data corruption or a non-unitary bug; the "
+              "segmented runner rolls back to the last CRC-verified "
+              "generation and replays"),
+    "QT402": ("error", "per-shard checksum divergence",
+              "one shard's partial-norm checksum disagrees with the "
+              "psum-folded total the other shards agree on; the finding "
+              "names the divergent shard -- suspect that device's memory "
+              "or interconnect"),
+    "QT403": ("warning", "malformed or unknown QUEST_SENTINEL entry "
+                         "ignored",
+              "use kind[:cadence] with kind in "
+              "quest_tpu.resilience.sentinel.KINDS and cadence a "
+              "positive integer, 'every_N', or 'segment'"),
+    "QT404": ("error", "density-register trace/hermiticity breach",
+              "Re tr(rho) drifted from 1 beyond the band or rho is no "
+              "longer Hermitian within it; the state is not a density "
+              "matrix any more -- roll back or fail closed"),
+    "QT405": ("error", "watchdog deadline exceeded (hung collective or "
+                       "dispatch)",
+              "the guarded call did not return within QUEST_WATCHDOG_MS; "
+              "a typed QuESTHangError was raised instead of blocking "
+              "forever -- check the mesh for a wedged device"),
 }
 
 

@@ -177,10 +177,13 @@ def _load_range(directory, index, start, stop, dtype, num_amps):
         if "crc32" in entry:
             crc = zlib.crc32(np.ascontiguousarray(data).tobytes())
             if crc != int(entry["crc32"]):
-                raise QuESTError(
+                from .resilience.errors import QuESTChecksumError
+                raise QuESTChecksumError(
                     f"checkpoint shard {entry['file']!r} failed CRC32 "
                     f"verification (payload {crc:#010x} != index "
-                    f"{int(entry['crc32']):#010x})")
+                    f"{int(entry['crc32']):#010x})",
+                    shard=entry["file"],
+                    expected_crc=int(entry["crc32"]), actual_crc=int(crc))
         lo, hi = max(s, start), min(e, stop)
         out[:, lo - start:hi - start] = data[:, lo - s:hi - s]
         filled += hi - lo

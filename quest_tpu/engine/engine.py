@@ -54,6 +54,26 @@ Failure semantics (ISSUE 7 -- request-level, like Orca-style serving):
   futures with :class:`~quest_tpu.resilience.QuESTCancelledError` --
   a waiter blocked on ``result()`` always wakes with a typed error.
 
+Health states (ISSUE 8 -- engine-level, fed by the integrity machinery):
+
+- :meth:`health` is ``healthy`` | ``degraded`` | ``quarantined``.
+  A sentinel breach on a dispatch result (``QUEST_SENTINEL`` armed,
+  :mod:`quest_tpu.resilience.sentinel` -- the corrupt result is NEVER
+  served; its future resolves with
+  :class:`~quest_tpu.resilience.QuESTIntegrityError`) marks the engine
+  ``degraded``; a second breach, or a watchdog deadline expiry
+  (``QUEST_WATCHDOG_MS`` around the whole dispatch, typed
+  :class:`~quest_tpu.resilience.QuESTHangError`), marks it
+  ``quarantined``.
+- A quarantined engine rejects submits through the existing
+  backpressure path (``QuESTBackpressureError``,
+  ``engine_backpressure_total{reason=quarantined}``) until the operator
+  calls :meth:`revive` -- in-flight and already-queued work still
+  completes, so quarantine sheds load without dropping accepted futures.
+- Three consecutive clean dispatches heal ``degraded`` back to
+  ``healthy``; transitions count
+  ``engine_health_transitions_total{from,to}``.
+
 Lifecycle: construct, optionally :meth:`warmup`, ``submit``/``run``, then
 :meth:`close` -- which drains the queue (every accepted future resolves)
 and joins the batcher thread. The engine is also a context manager.
@@ -70,12 +90,21 @@ from concurrent.futures import Future
 
 from .. import telemetry
 from ..resilience import faultinject as _faults
+from ..resilience import sentinel as _sentinel
+from ..resilience import watchdog as _watchdog
 from ..resilience.errors import (PoisonedRequestFault, QuESTBackpressureError,
-                                 QuESTCancelledError, QuESTTimeoutError)
+                                 QuESTCancelledError, QuESTHangError,
+                                 QuESTIntegrityError, QuESTTimeoutError)
 from . import cache as _cache
 from .params import bind
 
-__all__ = ["Engine"]
+__all__ = ["Engine", "HEALTH_STATES"]
+
+#: engine health states, healthiest first
+HEALTH_STATES = ("healthy", "degraded", "quarantined")
+
+#: consecutive clean dispatches that heal ``degraded`` -> ``healthy``
+_HEAL_STREAK = 3
 
 
 class _Request:
@@ -182,6 +211,10 @@ class Engine:
         self._cv = threading.Condition()
         self._q: deque = deque()
         self._open = True
+        self._health = "healthy"
+        self._breaches = 0        # sentinel breaches since last full heal
+        self._clean_streak = 0    # consecutive clean dispatches
+        self._dispatches = 0      # dispatch ordinal = the sentinel tick
         self._thread = threading.Thread(target=self._loop,
                                         name="quest-engine", daemon=True)
         self._thread.start()
@@ -222,6 +255,16 @@ class Engine:
         with self._cv:
             if not self._open:
                 raise RuntimeError("Engine is closed")
+            if self._health == "quarantined":
+                # quarantine sheds load through the EXISTING backpressure
+                # contract: callers already handle QuESTBackpressureError
+                telemetry.inc("engine_backpressure_total",
+                              reason="quarantined")
+                raise QuESTBackpressureError(
+                    f"engine is quarantined ({self._breaches} integrity "
+                    f"breach(es) recorded): rejecting "
+                    f"{len(values_list)} request(s); investigate, then "
+                    f"revive()", "Engine.submit")
             if self.queue_max and \
                     len(self._q) + len(values_list) > self.queue_max:
                 telemetry.inc("engine_backpressure_total")
@@ -248,6 +291,57 @@ class Engine:
     def run(self, params: dict | None = None):
         """Synchronous convenience: ``submit(params).result()``."""
         return self.submit(params).result()
+
+    # -- health -------------------------------------------------------------
+
+    def health(self) -> str:
+        """Current health state: ``healthy`` | ``degraded`` |
+        ``quarantined`` (see module docstring)."""
+        with self._cv:
+            return self._health
+
+    def revive(self) -> str:
+        """Operator acknowledgement after a quarantine: transition
+        ``quarantined`` -> ``degraded`` (submits are accepted again, and
+        ``healthy`` returns after :data:`_HEAL_STREAK` clean dispatches).
+        No-op in any other state. Returns the new state."""
+        with self._cv:
+            if self._health == "quarantined":
+                self._transition("degraded", reason="revive")
+                self._clean_streak = 0
+            return self._health
+
+    def _transition(self, to: str, *, reason: str) -> None:
+        # callers hold self._cv
+        if to == self._health:
+            return
+        telemetry.inc("engine_health_transitions_total",
+                      **{"from": self._health, "to": to})
+        telemetry.event("engine.health", previous=self._health, state=to,
+                        reason=reason)
+        self._health = to
+
+    def _note_breach(self, *, hang: bool) -> None:
+        with self._cv:
+            self._clean_streak = 0
+            if hang:
+                # a wedged dispatch is not self-healable: straight to
+                # quarantined, the operator must look at the mesh
+                self._transition("quarantined", reason="hang")
+                return
+            self._breaches += 1
+            self._transition(
+                "quarantined" if self._breaches >= 2 else "degraded",
+                reason="sentinel_breach")
+
+    def _note_clean(self) -> None:
+        with self._cv:
+            if self._health != "degraded":
+                return
+            self._clean_streak += 1
+            if self._clean_streak >= _HEAL_STREAK:
+                self._breaches = 0
+                self._transition("healthy", reason="clean_streak")
 
     def warmup(self, params: dict | None = None) -> "Engine":
         """Trace + compile both dispatch shapes (single and full batch) so
@@ -392,12 +486,35 @@ class Engine:
 
     def _dispatch(self, batch: list) -> None:
         mode = self._mode()
+        self._dispatches += 1
         telemetry.inc("engine_batches_total", mode=mode)
         telemetry.observe("engine_batch_size", len(batch))
+        # the injectable hang point: one visit per dispatch; with
+        # QUEST_WATCHDOG_MS armed the WHOLE dispatch (tracing included --
+        # it begins and ends on the watchdog's worker thread, so jax's
+        # thread-local trace state never splits) is deadline-bounded
+        hang = (_faults.enabled()
+                and _faults.fire("engine.dispatch") == "hang")
         try:
             with telemetry.span("engine.dispatch", mode=mode,
                                 batch=len(batch)):
-                self._dispatch_one(batch, mode)
+                _watchdog.watched(
+                    lambda: self._dispatch_one(batch, mode),
+                    site="engine.dispatch", hang=hang)
+        except QuESTHangError as e:
+            # no bisection: a wedged dispatch would wedge each half too;
+            # fail the batch typed and quarantine the engine
+            self._note_breach(hang=True)
+            for req in batch:
+                if not req.fut.done():
+                    req.fut.set_exception(e)
+        except QuESTIntegrityError as e:
+            # a corrupt result was caught BEFORE any future resolved with
+            # it: fail the remainder typed, degrade (quarantine on repeat)
+            self._note_breach(hang=False)
+            for req in batch:
+                if not req.fut.done():
+                    req.fut.set_exception(e)
         except Exception:
             # a failed batch bisects through the same executable: healthy
             # requests complete bit-identically, poisoned ones carry their
@@ -407,6 +524,8 @@ class Engine:
             for req in batch:
                 if not req.fut.done():
                     req.fut.set_exception(e)
+        else:
+            self._note_clean()
         now = time.perf_counter()
         for req in batch:
             telemetry.observe("engine_request_latency_seconds", now - req.t0)
@@ -436,12 +555,40 @@ class Engine:
             except BaseException:
                 self._bisect(half, mode)
 
+    def _sentinel_gate(self, amps) -> None:
+        """Check one dispatch result against the armed sentinel policy
+        (no-op boolean when ``QUEST_SENTINEL`` is off); raises
+        QuESTIntegrityError rather than letting a corrupt state reach its
+        future. The ``state.corrupt`` injection visit happens here too, so
+        SDC tests corrupt real results, not synthetic arrays."""
+        if not _sentinel.enabled():
+            return amps
+        findings = _sentinel.check_amps(
+            amps, density=self.circuit.is_density_matrix,
+            n=self.circuit.num_qubits,
+            mesh=self._mesh if self.sharded else None,
+            tick=self._dispatches, where="engine.dispatch")
+        if findings:
+            raise QuESTIntegrityError(
+                "dispatch result breached the integrity sentinels: "
+                + "; ".join(f.code for f in findings),
+                "Engine._dispatch", findings=findings)
+        return amps
+
+    def _maybe_corrupt(self, amps):
+        if not _faults.enabled():
+            return amps
+        from ..resilience import guard as _guard
+        return _guard.corrupt_amps(amps)
+
     def _dispatch_sequential(self, batch: list) -> None:
         x = self._exec1()
         for req in batch:
             if req.poison is not None:
                 raise PoisonedRequestFault("engine.request", req.poison)
-            res = x.with_values(self.initial_amps + 0, req.values)
+            res = self._maybe_corrupt(
+                x.with_values(self.initial_amps + 0, req.values))
+            self._sentinel_gate(res)
             if not req.fut.done():
                 req.fut.set_result(res)
 
@@ -456,7 +603,9 @@ class Engine:
                 raise PoisonedRequestFault("engine.request", req.poison)
         if not self._lifted.slots:
             # value-free structure: every request computes the same state
-            out = self._exec1().with_values(self.initial_amps + 0, ())
+            out = self._maybe_corrupt(
+                self._exec1().with_values(self.initial_amps + 0, ()))
+            self._sentinel_gate(out)
             for req in batch:
                 if not req.fut.done():
                     req.fut.set_result(out)
@@ -468,5 +617,7 @@ class Engine:
         amps_b = jnp.repeat(self.initial_amps[None], self.max_batch, axis=0)
         out = self._execB()(amps_b, stacked)
         for i, req in enumerate(batch):
+            lane = self._maybe_corrupt(out[i])
+            self._sentinel_gate(lane)
             if not req.fut.done():
-                req.fut.set_result(out[i])
+                req.fut.set_result(lane)

@@ -63,9 +63,17 @@ def _launch(kernel, mesh, amps):
     through the resilience guard (site ``exchange.collective``): a direct
     call when no fault plan is installed; injected transient comm faults
     retry under the backoff policy and exhaustion fails closed with a
-    typed QuESTRetryError (quest_tpu.resilience.guard.collective)."""
+    typed QuESTRetryError (quest_tpu.resilience.guard.collective). With
+    ``QUEST_WATCHDOG_MS`` armed the launch is deadline-bounded -- a hung
+    collective raises a typed QuESTHangError instead of blocking forever
+    -- EXCEPT under jit tracing: jax trace state is thread-local, so a
+    traced launch must stay on the tracing thread (the compiled
+    execution is covered by the engine-dispatch watchdog instead)."""
+    import jax
+
     from ..resilience import guard
-    return guard.collective(lambda: shard_map(kernel, **_specs(mesh))(amps))
+    return guard.collective(lambda: shard_map(kernel, **_specs(mesh))(amps),
+                            watched=not isinstance(amps, jax.core.Tracer))
 
 
 def _rank_bit(r, q, nl):

@@ -1,11 +1,12 @@
-"""Resilience layer: fault injection, retry/backoff, and preemption-safe
-segmented execution (ISSUE 7).
+"""Resilience layer: fault injection, retry/backoff, preemption-safe
+segmented execution (ISSUE 7), and the integrity/self-healing machinery
+(ISSUE 8).
 
 The reference fails closed and fails whole -- QuEST validates inputs and
 then assumes every MPI exchange, kernel launch, and file write succeeds.
 Serving production traffic (ROADMAP north star) needs every failure mode
 to be *injectable*, *observed*, and either retried to a bit-identical
-result or failed closed with a typed error. Four pieces:
+result or failed closed with a typed error. Six pieces:
 
 - :mod:`.faultinject` -- seeded deterministic fault plans
   (``QUEST_FAULTS=site:kind:nth[,...]``) fired at named sites in the hot
@@ -20,14 +21,26 @@ result or failed closed with a typed error. Four pieces:
   payloads for the verified loader to catch.
 - :mod:`.segmented` -- ``Circuit.run_segmented`` / :func:`resume_segmented`:
   checkpointed execution at frame-identity boundaries with CRC-verified
-  generation fallback.
+  generation fallback, plus sentinel-driven rollback-and-replay when a
+  policy is armed.
+- :mod:`.sentinel` -- online integrity sentinels (``QUEST_SENTINEL``):
+  precision-banded total-probability drift, psum-folded per-shard
+  checksums (the QT402 finding names the divergent shard), density
+  trace/hermiticity -- counted ``sentinel_checks_total{kind,outcome}``.
+- :mod:`.watchdog` -- deadline enforcement (``QUEST_WATCHDOG_MS``)
+  around collective launches and engine dispatches: a hung call raises a
+  typed ``QuESTHangError`` (QT405) instead of blocking forever.
 
 Typed errors (:mod:`.errors`) subclass
 :class:`~quest_tpu.validation.QuESTError`:
 ``QuESTTimeoutError`` (engine deadline), ``QuESTBackpressureError``
-(bounded queue full), ``QuESTCancelledError`` (dropped by
-``close(drain=False)``), ``QuESTPreemptionError`` (carries the resume
-cursor), ``QuESTRetryError`` (retry budget spent, no degradation path).
+(bounded queue full, or a quarantined engine), ``QuESTCancelledError``
+(dropped by ``close(drain=False)``), ``QuESTPreemptionError`` (carries
+the resume cursor), ``QuESTRetryError`` (retry budget spent, no
+degradation path), ``QuESTIntegrityError`` (sentinel breach the healing
+lattice could not clear; carries the QT4xx findings), ``QuESTHangError``
+(watchdog deadline; carries site and deadline_ms), ``QuESTChecksumError``
+(stored payload CRC divergence; carries shard + expected/actual CRC32).
 
 See docs/resilience.md for the failure-mode table and tools/chaos.py for
 the one-fault-per-site CI drill.
@@ -35,7 +48,8 @@ the one-fault-per-site CI drill.
 
 from .errors import (  # noqa: F401
     InjectedFault, KernelCompileFault, PoisonedRequestFault,
-    QuESTBackpressureError, QuESTCancelledError, QuESTPreemptionError,
+    QuESTBackpressureError, QuESTCancelledError, QuESTChecksumError,
+    QuESTHangError, QuESTIntegrityError, QuESTPreemptionError,
     QuESTRetryError, QuESTTimeoutError, TransientFault,
 )
 from .faultinject import (  # noqa: F401
@@ -46,14 +60,21 @@ from .retry import RetryPolicy, call_with_retry, default_policy  # noqa: F401
 from .segmented import (  # noqa: F401
     resume_segmented, run_segmented, segment_plan,
 )
+from . import sentinel  # noqa: F401
+from . import watchdog  # noqa: F401
+from .sentinel import SentinelPolicy, SentinelSpec, sentinel_policy  # noqa: F401
+from .watchdog import watchdog_deadline  # noqa: F401
 
 __all__ = [
     "QuESTTimeoutError", "QuESTBackpressureError", "QuESTCancelledError",
-    "QuESTPreemptionError", "QuESTRetryError",
+    "QuESTPreemptionError", "QuESTRetryError", "QuESTIntegrityError",
+    "QuESTHangError", "QuESTChecksumError",
     "InjectedFault", "TransientFault", "KernelCompileFault",
     "PoisonedRequestFault",
     "SITES", "FaultPlan", "FaultSpec", "enabled", "active_plan", "install",
     "clear", "fault_plan", "fire",
     "RetryPolicy", "default_policy", "call_with_retry",
     "segment_plan", "run_segmented", "resume_segmented",
+    "sentinel", "SentinelPolicy", "SentinelSpec", "sentinel_policy",
+    "watchdog", "watchdog_deadline",
 ]

@@ -21,7 +21,8 @@ from ..validation import QuESTError
 
 __all__ = [
     "QuESTTimeoutError", "QuESTBackpressureError", "QuESTCancelledError",
-    "QuESTPreemptionError", "QuESTRetryError",
+    "QuESTPreemptionError", "QuESTRetryError", "QuESTIntegrityError",
+    "QuESTHangError", "QuESTChecksumError",
     "InjectedFault", "TransientFault", "KernelCompileFault",
     "PoisonedRequestFault",
 ]
@@ -59,6 +60,52 @@ class QuESTPreemptionError(QuESTError):
 class QuESTRetryError(QuESTError):
     """A retryable site stayed faulty past the retry policy's attempt or
     deadline budget and has no degradation path (fail closed)."""
+
+
+class QuESTIntegrityError(QuESTError):
+    """An online integrity sentinel (:mod:`.sentinel`) found silent data
+    corruption -- norm/trace drift beyond the precision band or a
+    divergent per-shard checksum -- and the self-healing lattice
+    (rollback + replay + degrade) could not produce a clean state.
+
+    Carries the sentinel ``findings`` (QT4xx
+    :class:`~quest_tpu.analysis.diagnostics.Finding` records) so callers
+    can name the breached invariant and the divergent shard."""
+
+    def __init__(self, message: str, func: str = "", findings=()):
+        super().__init__(message, func)
+        self.findings = list(findings)
+
+
+class QuESTHangError(QuESTError):
+    """A watchdog deadline (``QUEST_WATCHDOG_MS``) expired around a
+    collective launch or an engine dispatch: the caller gets this typed
+    error instead of blocking forever on a hung mesh. Carries ``site``
+    and the ``deadline_ms`` that was enforced."""
+
+    def __init__(self, message: str, func: str = "",
+                 site: str | None = None,
+                 deadline_ms: float | None = None):
+        super().__init__(message, func)
+        self.site = site
+        self.deadline_ms = deadline_ms
+
+
+class QuESTChecksumError(QuESTError):
+    """A stored payload failed CRC32 verification: the bytes on disk are
+    not the bytes that were indexed at write time. Carries the ``shard``
+    file name plus ``expected_crc`` (index) and ``actual_crc`` (payload)
+    so skip-and-fall-back paths (segmented resume, QT305) can report the
+    divergence precisely."""
+
+    def __init__(self, message: str, func: str = "",
+                 shard: str | None = None,
+                 expected_crc: int | None = None,
+                 actual_crc: int | None = None):
+        super().__init__(message, func)
+        self.shard = shard
+        self.expected_crc = expected_crc
+        self.actual_crc = actual_crc
 
 
 class InjectedFault(RuntimeError):

@@ -22,16 +22,34 @@ site                 kinds                   raised / effect
 ==================== ======================= ===========================
 ``pallas.dispatch``  ``transient, compile``  TransientFault (retried) /
                                              KernelCompileFault (degrade)
-``exchange.collective`` ``transient``        TransientFault (retried;
-                                             exhaustion fails closed)
+``exchange.collective`` ``transient, hang``  TransientFault (retried;
+                                             exhaustion fails closed) /
+                                             simulated hang the watchdog
+                                             (QUEST_WATCHDOG_MS) converts
+                                             to a typed QuESTHangError
 ``engine.request``   ``poison``              PoisonedRequestFault pinned
                                              to one request at submit
+``engine.dispatch``  ``hang``                simulated hang inside one
+                                             engine dispatch; the
+                                             watchdog quarantines the
+                                             engine (QuESTHangError)
 ``checkpoint.write`` ``torn, corrupt, io``   truncate / bit-flip the
                                              just-written shard; ``io``
                                              raises TransientFault
 ``segment.boundary`` ``preempt``             QuESTPreemptionError between
                                              segments (after checkpoint)
+``state.corrupt``    ``bitflip[<shard>]``    deterministic single-bit
+                                             amplitude flip on the named
+                                             shard (default 0), applied
+                                             by guard.corrupt_amps for
+                                             the sentinels to catch
 ==================== ======================= ===========================
+
+The ``state.corrupt`` kind is parameterized: ``bitflip`` flips one bit on
+shard 0, ``bitflip3`` on shard 3 -- the shard-naming form the QT402
+checksum-divergence proofs use. Visits stay counted per SITE, so the
+corruption replays bit-identically (the rollback-and-replay recovery
+proofs depend on the nth visit replaying clean).
 
 Every fired fault counts ``fault_injected_total{site,kind}``. Malformed
 or unknown ``QUEST_FAULTS`` entries are skipped with a QT302 diagnostic
@@ -57,14 +75,26 @@ __all__ = ["SITES", "FaultSpec", "FaultPlan", "enabled", "active_plan",
 
 ENV_VAR = "QUEST_FAULTS"
 
-#: site name -> kinds a plan may inject there
+#: site name -> kinds a plan may inject there (``state.corrupt`` also
+#: accepts the shard-parameterized ``bitflip<N>`` form -- see _kind_ok)
 SITES: dict[str, tuple[str, ...]] = {
     "pallas.dispatch": ("transient", "compile"),
-    "exchange.collective": ("transient",),
+    "exchange.collective": ("transient", "hang"),
     "engine.request": ("poison",),
+    "engine.dispatch": ("hang",),
     "checkpoint.write": ("torn", "corrupt", "io"),
     "segment.boundary": ("preempt",),
+    "state.corrupt": ("bitflip",),
 }
+
+
+def _kind_ok(site: str, kind: str) -> bool:
+    """Exact catalog membership, plus the parameterized ``bitflip<N>``
+    (N = target shard index) form on ``state.corrupt``."""
+    if kind in SITES[site]:
+        return True
+    return (site == "state.corrupt" and kind.startswith("bitflip")
+            and kind[len("bitflip"):].isdigit())
 
 _EXC: dict[str, type[InjectedFault]] = {
     "transient": TransientFault,
@@ -118,7 +148,7 @@ class FaultPlan:
                 from_on = nth_s.endswith("+")
                 if site not in SITES:
                     why = f"unknown site (one of {sorted(SITES)})"
-                elif kind not in SITES[site]:
+                elif not _kind_ok(site, kind):
                     why = f"kind not valid for site (one of {SITES[site]})"
                 elif not nth_s.rstrip("+").isdigit() \
                         or int(nth_s.rstrip("+")) < 1:
@@ -242,10 +272,12 @@ def check(site: str) -> None:
     if kind == "preempt":
         raise QuESTPreemptionError(
             f"injected preemption at site {site!r}", site)
-    # torn/corrupt only make sense via corrupt_file(); reaching here means
-    # a site miswired the helper -- surface loudly rather than pass
-    raise QuESTError(f"fault kind {kind!r} at {site!r} needs corrupt_file()",
-                     "faultinject.check")
+    # torn/corrupt/bitflip/hang only make sense via their dedicated
+    # handlers (corrupt_file, guard.corrupt_amps, the watchdog); reaching
+    # here means a site miswired the helper -- surface loudly, don't pass
+    raise QuESTError(f"fault kind {kind!r} at {site!r} needs its dedicated "
+                     "handler (corrupt_file / guard.corrupt_amps / "
+                     "watchdog.watched)", "faultinject.check")
 
 
 def corrupt_file(site: str, path: str) -> str | None:

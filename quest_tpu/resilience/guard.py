@@ -1,8 +1,10 @@
-"""Site guards: where fault injection, retry, and degradation meet.
+"""Site guards: where fault injection, retry, watchdog, and degradation
+meet.
 
 Each hot path wraps its failable operation in one of these guards. When no
 fault plan is installed (the production default) every guard is a direct
-call -- one boolean read of :func:`faultinject.enabled` -- so the suite's
+call -- one boolean read of :func:`faultinject.enabled` (plus one cached
+watchdog-deadline read on the collective path) -- so the suite's
 zero-new-fallbacks acceptance criterion holds by construction.
 
 With a plan installed the guard visits its site (which may raise a typed
@@ -15,9 +17,18 @@ documented exit:
   ``engine_fallback_total{reason=fault_degraded}``;
 - ``exchange.collective`` -- fail closed with
   :class:`~quest_tpu.resilience.errors.QuESTRetryError` (a collective
-  that stays down has no single-device rewrite at this layer);
+  that stays down has no single-device rewrite at this layer); injected
+  ``hang`` faults stall the launch past the watchdog deadline so the
+  typed :class:`~quest_tpu.resilience.errors.QuESTHangError` path is
+  provable;
 - ``checkpoint.write``   -- retried ``io`` faults, torn/corrupt payload
-  mutations applied post-write so verification (CRC) catches them.
+  mutations applied post-write so verification (CRC) catches them;
+- ``state.corrupt``      -- deterministic single-bit amplitude flips
+  (:func:`corrupt_amps`) for the integrity sentinels to catch;
+- sentinel breaches      -- :func:`sentinel_replay` drives the
+  self-healing escalation lattice: retry the same route from the last
+  verified state, then degrade (eager fallback replay), then fail closed
+  with :class:`~quest_tpu.resilience.errors.QuESTIntegrityError`.
 """
 
 from __future__ import annotations
@@ -25,11 +36,12 @@ from __future__ import annotations
 from typing import Callable, TypeVar
 
 from .. import telemetry
-from . import faultinject, retry
-from .errors import (KernelCompileFault, QuESTRetryError, TransientFault)
+from . import faultinject, retry, watchdog
+from .errors import (KernelCompileFault, QuESTIntegrityError,
+                     QuESTRetryError, TransientFault)
 
 __all__ = ["DEGRADED", "pallas_dispatch", "collective", "checkpoint_write",
-           "segment_boundary"]
+           "segment_boundary", "corrupt_amps", "sentinel_replay"]
 
 T = TypeVar("T")
 
@@ -63,18 +75,32 @@ def pallas_dispatch(attempt: Callable[[], T],
         return DEGRADED
 
 
-def collective(fn: Callable[[], T], *,
-               site: str = "exchange.collective") -> T:
-    """Run a collective launch: retry injected transients, fail closed
-    with a typed :class:`QuESTRetryError` when the budget is spent."""
+def collective(fn: Callable[[], T], *, site: str = "exchange.collective",
+               watched: bool = True) -> T:
+    """Run a collective launch: retry injected transients (failing closed
+    with a typed :class:`QuESTRetryError` when the budget is spent), and
+    -- when ``QUEST_WATCHDOG_MS`` is armed and ``watched`` -- bound the
+    launch by the watchdog deadline. Callers pass ``watched=False`` under
+    ``jit`` tracing (jax trace state is thread-local, so a traced launch
+    must not move to the watchdog's worker thread); an injected ``hang``
+    then degenerates to the bounded :data:`watchdog.HANG_SLEEP_S` stall."""
+    deadline = watchdog.deadline_s() if watched else None
     if not faultinject.enabled():
-        return fn()
+        if deadline is None:
+            return fn()
+        return watchdog.watched(fn, site=site, deadline=deadline)
 
     def guarded() -> T:
-        faultinject.check(site)
-        return fn()
+        kind = faultinject.fire(site)
+        if kind == "transient":
+            raise TransientFault(site, kind)
+        return watchdog.watched(fn, site=site, deadline=deadline,
+                                hang=(kind == "hang"))
 
     try:
+        # QuESTHangError is NOT retryable: a deadline breach escalates to
+        # the caller (engine quarantine / fail closed), never a silent
+        # second eternal wait
         return retry.call_with_retry(guarded, site=site)
     except TransientFault as e:
         raise QuESTRetryError(
@@ -142,3 +168,75 @@ def segment_boundary(cursor: int, checkpoint_dir: str) -> None:
             f"injected preemption after checkpoint at tape cursor {cursor}"
             f" (resume from {checkpoint_dir!r})", "run_segmented",
             cursor=cursor, checkpoint_dir=checkpoint_dir)
+
+
+def corrupt_amps(amps, *, site: str = "state.corrupt"):
+    """Visit the SDC injection site over a planar ``(2, N)`` amplitude
+    array: on a ``bitflip[<shard>]`` fire, flip the top exponent bit of
+    one real-plane amplitude in the middle of the named shard's chunk
+    (deterministic -- visit-counted and position-fixed, so the recovery
+    replay is provably bit-identical) and return the corrupted array with
+    the ORIGINAL sharding; otherwise return ``amps`` untouched. Flipping
+    the exponent MSB turns even an exactly-zero amplitude into 2.0, so
+    the norm leaves every tolerance band -- the sentinels cannot miss a
+    flip that actually landed."""
+    if not faultinject.enabled():
+        return amps
+    kind = faultinject.fire(site)
+    if kind is None or not kind.startswith("bitflip"):
+        return amps
+    import numpy as np
+    shard = int(kind[len("bitflip"):] or 0)
+    host = np.array(amps)  # host copy; never mutate the live buffer
+    mesh = getattr(getattr(amps, "sharding", None), "mesh", None)
+    nshards = max(1, getattr(mesh, "size", 1) or 1)
+    chunk = host.shape[-1] // nshards
+    idx = (shard % nshards) * chunk + chunk // 2
+    real = host[0].reshape(-1)
+    if real.dtype == np.float64:
+        view, bit = real.view(np.uint64), 62
+    else:
+        view, bit = real.view(np.uint32), 30
+    view[idx] ^= np.asarray(1 << bit, dtype=view.dtype)
+    telemetry.event("resilience.sdc_injected", site=site,
+                    shard=shard % nshards, index=int(idx),
+                    dtype=str(host.dtype))
+    sharding = getattr(amps, "sharding", None)
+    if sharding is None:
+        return host
+    import jax
+    return jax.device_put(host, sharding)
+
+
+def sentinel_replay(replay: Callable[[], T],
+                    degrade: Callable[[], T] | None = None,
+                    *, site: str = "segment.sentinel") -> T:
+    """Drive the self-healing escalation lattice after an integrity
+    breach. ``replay`` rolls the register back to the last verified state,
+    re-runs the breached span on the SAME route, re-checks the sentinels
+    and raises :class:`QuESTIntegrityError` if they breach again; it is
+    retried under the :mod:`.retry` policy (transient SDC -- a one-off
+    flip -- heals on the first replay). On exhaustion, ``degrade`` (an
+    eager fallback-route replay from the same verified state) runs once;
+    if even that breaches, the :class:`QuESTIntegrityError` propagates --
+    fail closed, never serve a corrupt state. Outcomes count
+    ``segmented_rollbacks_total{outcome=replayed|degraded|failed}``."""
+    try:
+        out = retry.call_with_retry(replay, site=site,
+                                    retryable=(QuESTIntegrityError,))
+        telemetry.inc("segmented_rollbacks_total", outcome="replayed")
+        return out
+    except QuESTIntegrityError as e:
+        if degrade is None:
+            telemetry.inc("segmented_rollbacks_total", outcome="failed")
+            raise
+        telemetry.inc("engine_fallback_total", reason="sentinel_degraded")
+        telemetry.event("resilience.sentinel_degrade", site=site,
+                        findings=len(getattr(e, "findings", ())))
+        try:
+            out = degrade()
+        except QuESTIntegrityError:
+            telemetry.inc("segmented_rollbacks_total", outcome="failed")
+            raise
+        telemetry.inc("segmented_rollbacks_total", outcome="degraded")
+        return out

@@ -21,14 +21,33 @@ segments, after the checkpoint is durable.
 
 :func:`resume_segmented` walks generations newest-first, picks the last
 one that passes :func:`~quest_tpu.checkpoint.verify_snapshot` (rejected
-generations are flight-recorded QT305 and skipped -- a torn or
-bit-flipped shard falls back to the previous generation instead of
-failing the resume), reloads the register and RNG, and replays the
+generations are flight-recorded QT305 and skipped -- a CRC-divergent
+shard counts ``outcome=skipped_corrupt`` with the expected/actual CRC32
+in the finding, every other failure ``outcome=rejected_gen`` -- so a
+torn or bit-flipped shard falls back to the previous generation instead
+of failing the resume), reloads the register and RNG, and replays the
 remaining segments. Segment executables are deterministic functions of
 the tape slice, and snapshot round-trips are exact, so an interrupted +
 resumed run is bit-identical to an uninterrupted segmented run -- the
 property tests/test_resilience.py proves on the 8-device mesh for both
 the f32 and the double-float route.
+
+Self-healing (ISSUE 8): with a sentinel policy armed
+(:mod:`~quest_tpu.resilience.sentinel`, ``QUEST_SENTINEL``), every
+segment boundary is also an integrity probe. A breach (norm drift,
+per-shard checksum divergence, trace/hermiticity loss) triggers
+rollback-and-replay BEFORE the corrupt state can be checkpointed: the
+register rolls back to the last verified state -- the CRC-verified
+generation at the segment's start cursor, or an in-memory baseline for
+the first segment of a fresh run (writing a gen-0 snapshot just to have
+a rollback target would charge every clean run the cost of one extra
+checkpoint) -- and the segment replays on the same route under the
+:func:`guard.sentinel_replay` escalation lattice: retry -> eager
+fallback-route replay -> fail closed with
+:class:`~quest_tpu.resilience.errors.QuESTIntegrityError`. Because
+fault-injection visits are counted, an injected single-bit flip
+(``state.corrupt:bitflip<shard>:nth``) does NOT re-fire on the replay,
+so recovery is provably bit-identical to the uncorrupted run.
 """
 
 from __future__ import annotations
@@ -39,7 +58,8 @@ import shutil
 
 from .. import telemetry
 from ..validation import QuESTError
-from . import guard
+from . import faultinject, guard, sentinel
+from .errors import QuESTChecksumError, QuESTIntegrityError
 
 __all__ = ["segment_plan", "run_segmented", "resume_segmented"]
 
@@ -58,6 +78,17 @@ def _qt305(gen_dir: str, why: str) -> None:
     emit_findings([make_finding(
         "QT305", f"checkpoint generation {os.path.basename(gen_dir)!r} "
         f"failed verification ({why}); falling back to an older generation",
+        "resilience.segmented")])
+
+
+def _qt305_crc(gen_dir: str, e) -> None:
+    from ..analysis.diagnostics import emit_findings, make_finding
+    expected = e.expected_crc if e.expected_crc is not None else 0
+    actual = e.actual_crc if e.actual_crc is not None else 0
+    emit_findings([make_finding(
+        "QT305", f"checkpoint generation {os.path.basename(gen_dir)!r} "
+        f"shard {e.shard!r} is corrupt: payload CRC32 {actual:#010x} != "
+        f"indexed {expected:#010x}; skipping this generation",
         "resilience.segmented")])
 
 
@@ -160,18 +191,120 @@ def _checkpoint(circuit, qureg, checkpoint_dir: str, cursor: int,
     return gen
 
 
-def _execute(circuit, qureg, cuts, start: int, checkpoint_dir: str,
-             every_n_items: int, keep: int):
+def _run_segment(circuit, qureg, lo: int, hi: int) -> None:
     from ..circuits import Circuit
 
+    seg = Circuit(circuit.num_qubits, circuit.is_density_matrix)
+    seg._tape = list(circuit._tape[lo:hi])
+    with telemetry.span("segmented.segment", lo=lo, hi=hi):
+        seg.run(qureg)
+    telemetry.inc("segmented_segments_total")
+    if faultinject.enabled():
+        # the SDC injection point: one visit of state.corrupt per segment
+        # execution (replays re-visit it, so an nth-scoped bit-flip stays
+        # out of the healing replay by construction)
+        corrupted = guard.corrupt_amps(qureg.amps)
+        if corrupted is not qureg.amps:
+            qureg.put(corrupted)
+
+
+def _capture_baseline(qureg):
+    """In-memory rollback target for the first segment of a fresh run
+    (no disk generation exists yet): host amplitudes + the env RNG
+    stream, the same pair a generation snapshot round-trips."""
+    import numpy as np
+    env = qureg.env
+    rng = env.rng.get_state() if env is not None and env.rng is not None \
+        else None
+    return np.array(qureg.amps), rng
+
+
+def _rollback(qureg, lo: int, checkpoint_dir: str, baseline) -> None:
+    telemetry.event("segmented.rollback", cursor=lo,
+                    source="baseline" if baseline is not None else "gen")
+    if baseline is not None:
+        host, rng = baseline
+        import jax
+        sharding = getattr(qureg.amps, "sharding", None)
+        qureg.put(jax.device_put(host) if sharding is None
+                  else jax.device_put(host, sharding))
+        if rng is not None and qureg.env is not None \
+                and qureg.env.rng is not None:
+            qureg.env.rng.set_state(rng)
+        return
+    from ..checkpoint import loadQureg
+
+    gen = os.path.join(checkpoint_dir, f"{_GEN_PREFIX}{lo:08d}")
+    # CRC-verified, fail-closed: a corrupt rollback target raises rather
+    # than feeding the replay a second bad state
+    restored = loadQureg(gen, qureg.env)
+    qureg.put(restored.amps)
+
+
+def _heal(circuit, qureg, lo: int, hi: int, checkpoint_dir: str,
+          baseline, policy, findings) -> None:
+    """Drive rollback-and-replay for a breached segment ``[lo, hi)``."""
+    where = f"segment[{lo}:{hi}]"
+    telemetry.event("segmented.heal", lo=lo, hi=hi,
+                    codes=",".join(f.code for f in findings))
+
+    def _recheck(stage: str):
+        # tick=0 is divisible by every cadence: a healing re-check always
+        # runs ALL armed sentinel kinds, whatever the boundary schedule
+        again = sentinel.check_qureg(qureg, policy=policy, tick=0,
+                                     where=f"{where}:{stage}")
+        if again:
+            raise QuESTIntegrityError(
+                f"sentinel breach persists after {stage} of {where}: "
+                + "; ".join(f.code for f in again),
+                "run_segmented", findings=again)
+
+    def replay():
+        _rollback(qureg, lo, checkpoint_dir, baseline)
+        _run_segment(circuit, qureg, lo, hi)
+        _recheck("replay")
+        return True
+
+    def degrade():
+        # eager per-item replay with the Pallas route forced onto the
+        # engine fallback lattice: a compiled segment would cache-hit the
+        # suspect executable, so degradation must bypass the cache
+        _rollback(qureg, lo, checkpoint_dir, baseline)
+        from .. import fusion
+        from ..circuits import _register_mesh
+
+        with fusion.pallas_mesh(_register_mesh(qureg)):
+            with faultinject.fault_plan("pallas.dispatch:compile:1+"):
+                for f, a, kw in circuit._tape[lo:hi]:
+                    f(qureg, *a, **kw)
+        _recheck("degraded replay")
+        return True
+
+    guard.sentinel_replay(replay, degrade, site="segment.sentinel")
+
+
+def _execute(circuit, qureg, cuts, start: int, checkpoint_dir: str,
+             every_n_items: int, keep: int):
+    armed = sentinel.enabled()
+    policy = sentinel.active_policy() if armed else None
+    tick = 0
     for lo, hi in zip(cuts, cuts[1:]):
         if hi <= start:
             continue
-        seg = Circuit(circuit.num_qubits, circuit.is_density_matrix)
-        seg._tape = list(circuit._tape[lo:hi])
-        with telemetry.span("segmented.segment", lo=lo, hi=hi):
-            seg.run(qureg)
-        telemetry.inc("segmented_segments_total")
+        tick += 1
+        baseline = None
+        if armed and not os.path.isdir(
+                os.path.join(checkpoint_dir, f"{_GEN_PREFIX}{lo:08d}")):
+            # first segment of a fresh run: no generation to roll back to
+            baseline = _capture_baseline(qureg)
+        _run_segment(circuit, qureg, lo, hi)
+        if armed:
+            findings = sentinel.check_qureg(
+                qureg, policy=policy, tick=tick,
+                where=f"segment[{lo}:{hi}]")
+            if findings:
+                _heal(circuit, qureg, lo, hi, checkpoint_dir, baseline,
+                      policy, findings)
         _checkpoint(circuit, qureg, checkpoint_dir, hi, every_n_items, keep)
         if hi < cuts[-1]:
             # the injectable preemption point: the checkpoint above is
@@ -222,6 +355,13 @@ def resume_segmented(circuit, checkpoint_dir: str, env, *,
             with open(mpath) as f:
                 m = json.load(f)
             verify_snapshot(gen)
+        except QuESTChecksumError as e:
+            # silent payload corruption, specifically: name both CRCs and
+            # count it apart from structural rejections
+            _qt305_crc(gen, e)
+            telemetry.inc("segmented_resume_total",
+                          outcome="skipped_corrupt")
+            continue
         except (OSError, ValueError, QuESTError) as e:
             _qt305(gen, str(e))
             telemetry.inc("segmented_resume_total", outcome="rejected_gen")

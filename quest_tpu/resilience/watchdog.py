@@ -1,0 +1,173 @@
+"""Hung-collective / hung-dispatch watchdog (deadline enforcement).
+
+A flipped bit produces a wrong answer; a wedged device produces NO
+answer -- the launch blocks forever and takes the whole serving process
+with it. This module bounds the two places a hang can capture the
+process: every collective launch (``parallel.exchange._launch``) and
+every engine dispatch (``engine.Engine._dispatch``). With
+``QUEST_WATCHDOG_MS`` set, the guarded call runs on a worker thread and
+the caller waits at most the deadline; expiry raises a typed
+:class:`~quest_tpu.resilience.errors.QuESTHangError` (flight-recorded
+QT405, counted ``watchdog_timeouts_total{site}``) instead of the eternal
+block. The abandoned worker thread is daemonic: a genuinely hung XLA
+call cannot be cancelled in-band, so the watchdog's contract is to free
+the CALLER (who can quarantine, shed load, or re-plan), not to unwedge
+the device.
+
+Unset/zero ``QUEST_WATCHDOG_MS`` disables enforcement: the guarded call
+runs inline on the caller's thread with zero new machinery -- the same
+one-boolean discipline as :mod:`.faultinject`. Malformed values fall
+back to disabled with a QT303 diagnostic.
+
+Hangs are injectable (``exchange.collective:hang:nth`` /
+``engine.dispatch:hang:nth``): the worker sleeps past the deadline
+before calling through, so the watchdog proof fires deterministically.
+With the watchdog DISABLED an injected hang degenerates to a bounded
+stall (:data:`HANG_SLEEP_S`) -- tests must be able to observe the
+no-watchdog behavior without actually blocking forever.
+
+Deadline enforcement only applies to calls on concrete values: a
+collective visited during ``jit`` tracing must stay on the tracing
+thread (jax trace state is thread-local), so guards pass
+``watched=False`` under trace and the deadline covers the compiled
+execution path via the engine dispatch watchdog instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Callable, TypeVar
+
+from .. import telemetry
+from .errors import QuESTHangError
+
+__all__ = ["ENV_MS", "HANG_SLEEP_S", "deadline_s", "configure",
+           "watchdog_deadline", "watched"]
+
+T = TypeVar("T")
+
+ENV_MS = "QUEST_WATCHDOG_MS"
+
+#: bounded stand-in for an "eternal" injected hang when no watchdog is
+#: armed (a test can prove the un-watched behavior without blocking)
+HANG_SLEEP_S = 0.1
+
+_UNSET = object()
+_override: object = _UNSET          # configure()/watchdog_deadline value
+_env_cache: object = _UNSET         # parsed QUEST_WATCHDOG_MS (None = off)
+_lock = threading.Lock()
+
+
+def _qt303(raw: str) -> None:
+    from ..analysis.diagnostics import emit_findings, make_finding
+    emit_findings([make_finding(
+        "QT303", f"{ENV_MS}={raw!r} is not numeric; watchdog disabled",
+        "resilience.watchdog")])
+
+
+def _qt405(site: str, deadline: float) -> None:
+    from ..analysis.diagnostics import emit_findings, make_finding
+    emit_findings([make_finding(
+        "QT405", f"guarded call at site {site!r} exceeded the "
+        f"{deadline * 1e3:.0f}ms watchdog deadline",
+        f"resilience.watchdog[{site}]")])
+
+
+def deadline_s() -> float | None:
+    """The enforced deadline in seconds, or None when the watchdog is
+    disabled. Reads ``QUEST_WATCHDOG_MS`` once (cached); an explicit
+    :func:`configure` value wins over the env."""
+    global _env_cache
+    if _override is not _UNSET:
+        return _override  # type: ignore[return-value]
+    if _env_cache is _UNSET:
+        with _lock:
+            if _env_cache is _UNSET:
+                raw = os.environ.get(ENV_MS, "").strip()
+                if not raw:
+                    _env_cache = None
+                else:
+                    try:
+                        ms = float(raw)
+                        _env_cache = ms / 1e3 if ms > 0 else None
+                    except ValueError:
+                        _qt303(raw)
+                        _env_cache = None
+    return _env_cache  # type: ignore[return-value]
+
+
+def configure(ms: float | None) -> None:
+    """Override the deadline (milliseconds; None/0 disables). Replaces
+    whatever ``QUEST_WATCHDOG_MS`` said; ``configure(None)`` does NOT
+    fall back to the env -- use :func:`reset` for that."""
+    global _override
+    _override = None if not ms else ms / 1e3
+
+
+def reset() -> None:
+    """Drop any :func:`configure` override and the cached env read."""
+    global _override, _env_cache
+    _override = _UNSET
+    _env_cache = _UNSET
+
+
+@contextlib.contextmanager
+def watchdog_deadline(ms: float | None):
+    """Context manager arming the watchdog at ``ms`` for the block
+    (tests/chaos); restores the previous setting on exit."""
+    global _override
+    prev = _override
+    configure(ms)
+    try:
+        yield
+    finally:
+        _override = prev
+
+
+def watched(fn: Callable[[], T], *, site: str,
+            deadline: float | None = None, hang: bool = False) -> T:
+    """Run ``fn`` under the watchdog. ``deadline`` (seconds) defaults to
+    :func:`deadline_s`; None runs inline. ``hang=True`` marks an
+    injected hang (the caller's fault-plan fire already named this
+    visit): the worker sleeps past the deadline first, so the watchdog
+    proof is deterministic. Raises
+    :class:`~quest_tpu.resilience.errors.QuESTHangError` on expiry."""
+    dl = deadline if deadline is not None else deadline_s()
+    if dl is None:
+        if hang:
+            # no watchdog armed: the injected "eternal" hang degenerates
+            # to a bounded stall so the un-watched path stays testable
+            time.sleep(HANG_SLEEP_S)
+        return fn()
+
+    box: dict = {}
+    done = threading.Event()
+
+    def worker() -> None:
+        try:
+            if hang:
+                time.sleep(max(4 * dl, HANG_SLEEP_S))
+            box["out"] = fn()
+        except BaseException as e:  # noqa: BLE001 -- relayed to caller
+            box["err"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"quest-watchdog[{site}]")
+    t.start()
+    if not done.wait(dl):
+        telemetry.inc("watchdog_timeouts_total", site=site)
+        telemetry.event("resilience.watchdog_timeout", site=site,
+                        deadline_ms=dl * 1e3)
+        _qt405(site, dl)
+        raise QuESTHangError(
+            f"call at site {site!r} exceeded the {dl * 1e3:.0f}ms "
+            f"watchdog deadline [QT405]", "watchdog.watched",
+            site=site, deadline_ms=dl * 1e3)
+    if "err" in box:
+        raise box["err"]
+    return box["out"]

@@ -1,12 +1,13 @@
-"""Chaos scenario suite for the resilience layer (ISSUE 7 satellite).
+"""Chaos scenario suite for the resilience layer (ISSUE 7 + 8).
 
 Each scenario arms one fault class through ``quest_tpu.resilience``'s
 injection plan, runs a real circuit through the hardened path, and
-asserts BOTH the recovery behavior (retry / degrade / isolate / resume)
-and the final-state contract (bit-identity to the clean run, or
-allclose-to-oracle where the degrade lattice legitimately changes the
-compute order). This is the executable form of the failure-mode table in
-docs/resilience.md, run in CI next to the bench smoke.
+asserts BOTH the recovery behavior (retry / degrade / isolate / resume /
+rollback-and-replay / watchdog) and the final-state contract
+(bit-identity to the clean run, or allclose-to-oracle where the degrade
+lattice legitimately changes the compute order). This is the executable
+form of the failure-mode table in docs/resilience.md, run in CI next to
+the bench smoke.
 
 Usage:  python tools/chaos.py [--json]
 Prints one line per scenario plus a JSON summary; exits nonzero if any
@@ -208,7 +209,7 @@ def checkpoint_corrupt_resume_fallback(env, env8):
         assert np.array_equal(want, np.asarray(out.amps)), \
             "fallback resume diverged"
         assert telemetry.counter_value("segmented_resume_total",
-                                       outcome="rejected_gen") == 1
+                                       outcome="skipped_corrupt") == 1
     return {"checksum": _checksum(out.amps), "rejected_generation": gens[-1],
             "bit_identical": True}
 
@@ -244,6 +245,67 @@ def preempt_resume_sharded(env, env8):
                                    outcome="verified") == 1
     return {"checksum": _checksum(out.amps), "bit_identical": True,
             "devices": 8}
+
+
+@scenario
+def sdc_sentinel_rollback(env, env8):
+    """ISSUE 8: an injected single-bit amplitude flip mid-run is caught by
+    the armed sentinels at the next segment boundary, rolled back to the
+    last verified generation and replayed -- the healed run is
+    bit-identical to the uncorrupted one."""
+    import tempfile
+
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.resilience import fault_plan, sentinel_policy
+
+    c = _ghz_plus(10).fused(max_qubits=5, pallas=True, shard_devices=8)
+    q_ref = qt.createQureg(10, env8)
+    c.run(q_ref)
+    want = np.asarray(q_ref.amps)
+    with tempfile.TemporaryDirectory() as d:
+        telemetry.reset()
+        with sentinel_policy("norm:segment,checksum:segment"):
+            with fault_plan("state.corrupt:bitflip2:2"):
+                out = c.run_segmented(qt.createQureg(10, env8),
+                                      checkpoint_dir=d, every_n_items=1)
+    assert np.array_equal(want, np.asarray(out.amps)), "healed run diverged"
+    assert telemetry.counter_value("segmented_rollbacks_total",
+                                   outcome="replayed") == 1, \
+        "rollback-and-replay never engaged"
+    assert telemetry.counter_value("sentinel_checks_total",
+                                   kind="norm", outcome="breach") == 1
+    return {"checksum": _checksum(out.amps), "bit_identical": True,
+            "rollbacks_replayed": 1}
+
+
+@scenario
+def collective_hang_watchdog(env, env8):
+    """ISSUE 8: a hung collective launch is bounded by the
+    QUEST_WATCHDOG_MS deadline and raises a typed QuESTHangError (QT405)
+    instead of blocking the process forever."""
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.resilience import (QuESTHangError, fault_plan,
+                                      watchdog_deadline)
+
+    with qt.explicit_mesh(env8.mesh):  # warm the kernels off the deadline
+        qw = qt.createQureg(5, env8)
+        qt.hadamard(qw, 4)
+    telemetry.reset()
+    hung = False
+    with watchdog_deadline(200), fault_plan("exchange.collective:hang:1"):
+        try:
+            with qt.explicit_mesh(env8.mesh):
+                q = qt.createQureg(5, env8)
+                qt.hadamard(q, 4)
+        except QuESTHangError as e:
+            hung = True
+            assert e.site == "exchange.collective"
+    assert hung, "watchdog never fired on the injected hang"
+    assert telemetry.counter_value("watchdog_timeouts_total",
+                                   site="exchange.collective") == 1
+    return {"hang_failed_typed": True, "deadline_ms": 200}
 
 
 def main() -> int:
