@@ -122,6 +122,13 @@ def smoke_plan_specs() -> list:
          "build": lambda: serving_ansatz(20, 2),
          "mesh_shape": None, "dtype": None,
          "fused": {"max_qubits": 5, "pallas": True}},
+        # the comm_20q circuit planned WITH the pipeline knob stamped:
+        # the schedule check re-prices the depth-4 journal and proves the
+        # chunk-unit model is pipeline-invariant (ISSUE 10)
+        {"name": "comm_20q",
+         "build": lambda: build_circuit(20, 2),
+         "mesh_shape": (8,), "dtype": None, "fused": None,
+         "comm_pipeline": 4},
     ]
 
 
@@ -1103,6 +1110,119 @@ def bench_sentinel(n: int, depth: int, reps: int) -> dict:
     }
 
 
+def bench_comm(n: int, depth: int, reps: int) -> dict:
+    """CI-gate config ``comm_20q`` (round 8, ISSUE 10): the pipelined-
+    collectives A/B on a real multi-device mesh. Runs the SAME random
+    Clifford+T circuit monolithically (comm_pipeline=1) and pipelined
+    (depth 4) under the explicit scheduler and asserts the final states
+    are BIT-IDENTICAL (pipelining only re-times traffic; the sliced
+    blend/mask/scatter compute is elementwise, so equality is exact, not
+    approximate). The trace-time comm model is then re-planned WITH the
+    pipeline stamp and cross-checked: journal verifier green
+    (check_schedule re-prices the stamped journal -- the proof chunk-unit
+    pricing is depth-invariant) and telemetry chunk-units == the model.
+    Falls back to the host CPU devices when the default backend has a
+    single device (the CI box forces 8 via
+    ``xla_force_host_platform_device_count``); emits a note row when no
+    multi-device mesh is constructible."""
+    import time
+
+    import jax
+
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.analysis import check_circuit_comm
+    from quest_tpu.parallel.scheduler import comm_chunks
+
+    pipe = 4
+    metric = (f"pipelined collectives A/B, {n}q random Clifford+T under "
+              f"the explicit scheduler (monolithic vs depth-{pipe})")
+    devs = jax.devices()
+    if len(devs) < 2:
+        try:
+            devs = jax.devices("cpu")
+        except RuntimeError:
+            pass
+    if len(devs) < 2:
+        return {"config": "comm_20q", "metric": metric, "value": None,
+                "unit": "x speedup", "vs_baseline": None,
+                "note": "needs >= 2 devices "
+                        "(set xla_force_host_platform_device_count)"}
+    ndev = 1 << (len(devs).bit_length() - 1)
+    env = qt.createQuESTEnv(devs[:ndev])
+    circ = build_circuit(n, depth)
+    k = max(min(reps, 3), 1)
+
+    def run_leg(pl):
+        # both legs run 1 warm + k timed applications from the same init,
+        # so their final states stay directly comparable
+        q = qt.createQureg(n, env)
+        qt.initPlusState(q)
+        with qt.explicit_mesh(env.mesh, comm_pipeline=pl):
+            circ.run(q)
+            q.amps.block_until_ready()
+            best = float("inf")
+            for _ in range(k):
+                t0 = time.perf_counter()
+                circ.run(q)
+                q.amps.block_until_ready()
+                best = min(best, time.perf_counter() - t0)
+        return q, best
+
+    q_mono, mono_s = run_leg(1)
+    q_pipe, pipe_s = run_leg(pipe)
+    bitident = np.array_equal(qt.get_np(q_mono), qt.get_np(q_pipe))
+
+    t0 = sum(telemetry.counters("comm_chunk_units_total").values())
+    findings, stats, journal = check_circuit_comm(
+        circ, env.mesh, comm_pipeline=pipe, location="comm_20q")
+    t1 = sum(telemetry.counters("comm_chunk_units_total").values())
+    model = comm_chunks(stats)
+    errors = sum(1 for f in findings if f.severity == "error")
+    return {
+        "config": "comm_20q",
+        "metric": metric,
+        "value": round(mono_s / pipe_s, 3),
+        "unit": "x speedup",
+        "vs_baseline": None,
+        "detail": {
+            "qubits": n,
+            "depth": depth,
+            "devices": ndev,
+            "pipeline_depth": pipe,
+            "monolithic_ms": round(mono_s * 1e3, 2),
+            "pipelined_ms": round(pipe_s * 1e3, 2),
+            "pipelined_bitident": bool(bitident),
+            "journal_stamp": list(journal[0]) if journal else None,
+            "journal_errors": int(errors),
+            "model_chunk_units": round(model, 4),
+            "telemetry_chunk_units": round(t1 - t0, 6),
+            "model_matches_telemetry": bool(abs((t1 - t0) - model) < 1e-6),
+        },
+    }
+
+
+def _comm_config(reps: int, smoke: bool) -> dict:
+    """Run the comm_20q A/B, re-execing into an 8-virtual-host-device
+    subprocess when this process's backend has a single device (the host
+    device count is fixed at backend init, so it cannot be raised here).
+    ``_QUEST_COMM_SUBPROC`` marks the child so a box where the flag does
+    not take still terminates (bench_comm then emits its note row)."""
+    import jax
+
+    if jax.device_count() >= 2 or "_QUEST_COMM_SUBPROC" in os.environ:
+        return bench_comm(20, 2 if smoke else 4, reps)
+    flags = (os.environ.get("XLA_FLAGS", "")
+             + " --xla_force_host_platform_device_count=8").strip()
+    return _subprocess_config(
+        ["--config", "comm", "--reps", str(reps)]
+        + (["--smoke"] if smoke else []),
+        env={"XLA_FLAGS": flags, "_QUEST_COMM_SUBPROC": "1"},
+        budget_s=1800, unit="x speedup", slug="comm_20q",
+        metric="pipelined collectives A/B, 20q random Clifford+T under "
+               "the explicit scheduler (monolithic vs depth-4)")
+
+
 #: the committed full-detail artifact, written next to this file
 DETAIL_FILE = "BENCH_DETAIL.json"
 
@@ -1198,7 +1318,7 @@ def main() -> None:
                    choices=["all", "statevec", "density", "density_f64",
                             "f64", "plan_f64", "plan_34q_f64",
                             "20q", "24q", "26q", "serve", "resilience",
-                            "sentinel"],
+                            "sentinel", "comm"],
                    default="all",
                    help="all: every BASELINE.json milestone config (default);"
                         " statevec: one random Clifford+T run at --qubits;"
@@ -1221,7 +1341,10 @@ def main() -> None:
                         " bit-identity);"
                         " sentinel: the sentinel_20q row (armed-but-clean"
                         " integrity-probe overhead <5% CI gate, SDC"
-                        " rollback-and-replay bit-identity)")
+                        " rollback-and-replay bit-identity);"
+                        " comm: the comm_20q row (pipelined collectives"
+                        " A/B on a real multi-device mesh, bit-identity +"
+                        " depth-invariant comm model asserted)")
     p.add_argument("--emit", choices=["headline", "full"],
                    default="headline",
                    help="headline: compact <=1KB final line + "
@@ -1334,6 +1457,10 @@ def main() -> None:
         r = bench_sentinel(20, 2 if args.smoke else 4, args.reps)
         _emit(r, [r], args.emit)
         return
+    if args.config == "comm":
+        r = _comm_config(args.reps, args.smoke)
+        _emit(r, [r], args.emit)
+        return
     if args.config in ("20q", "24q", "26q"):
         r = bench_statevec(int(args.config[:-1]), args.depth, args.reps,
                            sync)
@@ -1368,6 +1495,10 @@ def main() -> None:
             # overhead (<5% CI gate) and the SDC rollback-and-replay
             # bit-identity contract
             cfgs.append(bench_sentinel(20, 2, 3))
+            # ... and the comm row: pipelined-collectives A/B on the
+            # 8-virtual-device mesh -- bit-identity at depth 4 and the
+            # depth-invariant comm model == telemetry (ISSUE 10 gate)
+            cfgs.append(_comm_config(3, True))
         _emit(r, cfgs, args.emit)
         return
 
@@ -1411,6 +1542,7 @@ def main() -> None:
                "(8-device model, frame transposes at the df 2x scale)"))
     configs.append(bench_resilience(20, 4, args.reps))
     configs.append(bench_sentinel(20, 4, args.reps))
+    configs.append(_comm_config(args.reps, False))
     # headline = the 26q statevec config, selected by metric string so list
     # reordering can never silently change what is reported
     headline = dict(next(c for c in configs

@@ -6,6 +6,8 @@ codes ``QT0xx`` lint / ``QT1xx`` plan / ``QT2xx`` kernel):
 - :mod:`.plancheck` -- symbolic FusePlan frame replay and scheduler
   journal re-pricing (the model-vs-plan gate),
 - :mod:`.ringcheck` -- abstract DMA-ring pipeline hazard/VMEM proofs,
+- :mod:`.commcheck` -- abstract comm-pipeline (pipelined collective)
+  transfer/compute hazard proofs,
 - :mod:`.tapelint` -- GateEvent tape lints (cancellations, mergeable
   rotations, param-lift candidates, apply-time traps).
 
@@ -24,6 +26,8 @@ from .. import telemetry
 from .diagnostics import (CATALOG, SEVERITIES, AnalysisError, Finding,
                           emit_findings, error_findings, make_finding,
                           render_json, render_text, summarize)
+from .commcheck import (check_comm_pipeline, check_pipeline_events,
+                        pipeline_events, sweep_comm_pipeline)
 from .plancheck import (check_circuit_comm, check_plan, check_schedule,
                         check_tape)
 from .ringcheck import check_events, check_ring, ring_events, sweep_reachable
@@ -35,6 +39,8 @@ __all__ = [
     "render_text", "render_json", "summarize",
     "check_plan", "check_tape", "check_schedule", "check_circuit_comm",
     "ring_events", "check_events", "check_ring", "sweep_reachable",
+    "pipeline_events", "check_pipeline_events", "check_comm_pipeline",
+    "sweep_comm_pipeline",
     "lint_events", "lint_tape", "lint_circuit",
     "verify_enabled", "verify_plan", "check_smoke_spec",
 ]
@@ -99,6 +105,7 @@ def check_smoke_spec(spec: dict) -> list:
         target = fz if fz is not None else circ
         sched_findings, _stats, _journal = check_circuit_comm(
             target, mesh, dtype=spec.get("dtype"),
+            comm_pipeline=spec.get("comm_pipeline"),
             location=f"{name}.schedule")
         findings += sched_findings
     return findings

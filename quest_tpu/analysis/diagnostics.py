@@ -30,6 +30,7 @@ report diagnostics without import cycles.
 from __future__ import annotations
 
 import json
+import os
 from dataclasses import asdict, dataclass
 from typing import Iterable, Optional
 
@@ -38,7 +39,7 @@ from .. import telemetry
 __all__ = [
     "Finding", "AnalysisError", "CATALOG", "SEVERITIES",
     "make_finding", "emit_findings", "error_findings",
-    "render_text", "render_json", "summarize",
+    "render_text", "render_json", "summarize", "parse_env_int",
 ]
 
 #: severity levels, most severe first
@@ -102,6 +103,21 @@ CATALOG: dict[str, tuple[str, str, str]] = {
     "QT205": ("warning", "QUEST_PALLAS_RING is malformed or out of range",
               "set QUEST_PALLAS_RING to an integer >= 2 (the 2-slot "
               "minimum); the malformed value was replaced"),
+    "QT206": ("warning", "QUEST_COMM_PIPELINE is malformed or out of "
+                         "range",
+              "set QUEST_COMM_PIPELINE to an integer >= 1 (1 = the "
+              "monolithic launch); the malformed value was replaced"),
+    "QT207": ("error", "comm pipeline slice overlap hazard",
+              "each sub-chunk transfer must be issued exactly once, land "
+              "before the compute that consumes it, and feed exactly one "
+              "compute"),
+    "QT208": ("error", "comm pipeline epilogue not drained",
+              "every issued transfer must land and be consumed and every "
+              "output slice emitted in order before the launch returns"),
+    "QT209": ("info", "comm pipeline depth clamped to the slice geometry",
+              "the effective depth is the largest power of two not above "
+              "the requested depth and the chunk's slice limit; request "
+              "a smaller depth to silence this"),
     # -- QT3xx: resilience (fault injection, retry, segmented runs) ---------
     "QT301": ("error", "multi-host initialization timed out or failed "
                        "against the coordinator",
@@ -205,6 +221,49 @@ def emit_findings(findings: Iterable[Finding]) -> None:
     for f in findings:
         telemetry.inc("analysis_findings_total", code=f.code,
                       severity=f.severity)
+
+
+def parse_env_int(env: str, default: int, *, minimum: int, code: str,
+                  warned: set, noun: str = "value",
+                  below: Optional[str] = None) -> int:
+    """The ONE env-int-parse-with-diagnostic: read integer env knob
+    ``env``, falling back to ``default`` on a malformed value and clamping
+    to ``minimum``, and flight-record a catalog ``code`` finding
+    (telemetry + RuntimeWarning) naming the value actually used -- once
+    per distinct raw value, tracked in the caller-owned ``warned`` set
+    (so each knob warns per process, not per launch). The silent coercion
+    stays -- the caller must still launch -- but it is no longer silent.
+    Shared by ``QUEST_PALLAS_RING`` (QT205) and ``QUEST_COMM_PIPELINE``
+    (QT206) instead of per-knob hand-rolled parsers."""
+    raw = os.environ.get(env, "").strip()
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        _env_int_diagnostic(env, code, raw, default, "is not an integer",
+                            noun, warned)
+        return default
+    if v < minimum:
+        _env_int_diagnostic(
+            env, code, raw, minimum,
+            below if below is not None else f"is below the minimum "
+                                            f"{minimum}", noun, warned)
+        return minimum
+    return v
+
+
+def _env_int_diagnostic(env: str, code: str, raw: str, used: int,
+                        why: str, noun: str, warned: set) -> None:
+    if raw in warned:
+        return
+    warned.add(raw)
+    import warnings
+
+    f = make_finding(code, f"{env}={raw!r} {why}; running with {noun} "
+                           f"{used}", f"env:{env}")
+    emit_findings([f])
+    warnings.warn(str(f), RuntimeWarning, stacklevel=4)
 
 
 def error_findings(findings: Iterable[Finding]) -> list[Finding]:

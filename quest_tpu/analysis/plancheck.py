@@ -221,7 +221,22 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
     for idx, rec in enumerate(journal):
         where = f"{location}[{idx}]:{rec[0]}"
         kind = rec[0]
-        if kind == "pair_exchange":
+        if kind == "comm_pipeline":
+            # the pipeline-depth stamp: a valid depth prices at ZERO
+            # chunk-units -- the depth-invariance proof the re-priced
+            # totals below then complete (any depth, same model) -- and
+            # its transfer/compute interleaving must simulate hazard-free
+            # (commcheck QT207/QT208)
+            _, depth = rec
+            if not isinstance(depth, int) or depth < 1:
+                findings.append(make_finding(
+                    "QT103", f"comm_pipeline stamp {depth!r} is not a "
+                             f"depth >= 1", where))
+            else:
+                from .commcheck import check_comm_pipeline
+                findings.extend(check_comm_pipeline(
+                    depth, 1 << nl, location=where))
+        elif kind == "pair_exchange":
             totals["pair_exchanges"] += 1
         elif kind == "rank_permute":
             _, rn, q = rec
@@ -323,10 +338,12 @@ def check_circuit_comm(circuit, mesh, *, num_slices: int = 1,
                        dtype=None, defer: bool = True,
                        collective_reconcile: bool = True,
                        batch_relocations: bool = True,
+                       comm_pipeline: int | None = None,
                        location: str = "plan_circuit"):
     """Plan ``circuit`` abstractly (zero devices) with journaling on and
-    verify the journal against the returned stats. Returns
-    ``(findings, stats, journal)``."""
+    verify the journal against the returned stats (``comm_pipeline``
+    stamps the depth into the journal; the re-priced totals prove the
+    model is depth-invariant). Returns ``(findings, stats, journal)``."""
     from ..parallel.scheduler import plan_circuit
 
     journal: list = []
@@ -334,7 +351,8 @@ def check_circuit_comm(circuit, mesh, *, num_slices: int = 1,
                          defer=defer,
                          collective_reconcile=collective_reconcile,
                          batch_relocations=batch_relocations,
-                         dtype=dtype, journal=journal)
+                         dtype=dtype, journal=journal,
+                         comm_pipeline=comm_pipeline)
     n = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
     findings = check_schedule(journal, stats, n, mesh, location=location)
     return findings, stats, journal

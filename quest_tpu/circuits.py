@@ -432,7 +432,8 @@ class Circuit:
 
     def fused(self, max_qubits: int = 5, dtype=None,
               pallas: bool = False, shard_devices: int | None = None,
-              ring_depth: int | None = None) -> "Circuit":
+              ring_depth: int | None = None,
+              comm_pipeline: int | None = None) -> "Circuit":
         """A new Circuit with runs of gates contracted into ``max_qubits``-
         qubit unitaries at trace time (see :mod:`quest_tpu.fusion`).
 
@@ -455,6 +456,13 @@ class Circuit:
         (ops.pallas_gates._make_dma_kernel): stamped onto every emitted
         PallasRun, it outranks the QUEST_PALLAS_RING env default when the
         runs execute. None leaves the process default in charge.
+
+        ``comm_pipeline`` is the comm-side twin: the collective pipeline
+        depth (parallel.exchange) stamped onto every emitted PallasRun and
+        FrameSwap, outranking the QUEST_COMM_PIPELINE env default when the
+        plan's frame relabelings ride the explicit scheduler's grouped
+        collectives. Bit-identical at every depth; 1 = the monolithic
+        launch. None leaves the process default in charge.
         """
         import numpy as np
 
@@ -513,6 +521,10 @@ class Circuit:
             for item in p.items:
                 if isinstance(item, fusion.PallasRun):
                     item.ring_depth = int(ring_depth)
+        if comm_pipeline is not None:
+            for item in p.items:
+                if isinstance(item, (fusion.PallasRun, fusion.FrameSwap)):
+                    item.comm_pipeline = int(comm_pipeline)
         from . import analysis
         if analysis.verify_enabled():
             # QUEST_VERIFY=1: statically verify the plan's frame/ring

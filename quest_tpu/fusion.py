@@ -445,6 +445,11 @@ class PallasRun:
     #: manual-DMA ring depth override for this run (None = the process
     #: default: QUEST_PALLAS_RING env, else pallas_gates._DEF_RING_DEPTH)
     ring_depth: int | None = None
+    #: comm-pipeline depth for the collective frame relabelings this run
+    #: triggers under the explicit scheduler (None = the scheduler's /
+    #: QUEST_COMM_PIPELINE default; bit-identical at every depth --
+    #: exchange.dist_permute_bits)
+    comm_pipeline: int | None = None
 
 
 @dataclass
@@ -460,6 +465,9 @@ class FrameSwap:
     tile_bits: int
     k: int
     hi: int | None = None
+    #: comm-pipeline depth when the transpose rides the scheduler's
+    #: grouped permute collective (None = default; see PallasRun)
+    comm_pipeline: int | None = None
 
 
 def _window(qubits) -> tuple:
@@ -1069,12 +1077,16 @@ def plan_from_tape(tape) -> FusePlan:
         if name == "_apply_pallas_run":
             ops, tb, lk, sk, lh, sh = a[:6]
             rd = a[6] if len(a) > 6 else None
+            cp = a[7] if len(a) > 7 else None
             p.items.append(PallasRun(tuple(ops), tb, load_swap_k=lk,
                                      store_swap_k=sk, load_swap_hi=lh,
-                                     store_swap_hi=sh, ring_depth=rd))
+                                     store_swap_hi=sh, ring_depth=rd,
+                                     comm_pipeline=cp))
         elif name == "_apply_frame_swap":
-            tb, k, hi = a
-            p.items.append(FrameSwap(tb, k, hi))
+            tb, k, hi = a[:3]
+            p.items.append(FrameSwap(tb, k, hi,
+                                     comm_pipeline=(a[3] if len(a) > 3
+                                                    else None)))
         elif name == "_apply_dense_block":
             p.items.append(FusedBlock(tuple(a[1]), a[0]))
         elif name == "_apply_gate_diag":
@@ -1273,7 +1285,8 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                       load_swap_k: int = 0, store_swap_k: int = 0,
                       load_swap_hi: int | None = None,
                       store_swap_hi: int | None = None,
-                      ring_depth: int | None = None) -> None:
+                      ring_depth: int | None = None,
+                      comm_pipeline: int | None = None) -> None:
     """Tape-entry wrapper for a PallasRun. Ops are RAW kernel ops over the
     full flattened state: density plans carry explicit conj-shadow twins
     (fusion._shadow_pop), so no path here re-derives shadows.
@@ -1339,7 +1352,7 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
         res = _guard.pallas_dispatch(
             lambda: _sched_df_pallas_run(
                 qureg, ops, sched, tile_bits, load_swap_k, store_swap_k,
-                load_swap_hi, store_swap_hi, ring_depth),
+                load_swap_hi, store_swap_hi, ring_depth, comm_pipeline),
             degrade=lambda: None)
         if res is not _guard.DEGRADED and res:
             return
@@ -1712,7 +1725,8 @@ def _dispatch_pallas_sharded(qureg, ops: tuple, mesh, tile_bits: int,
 
 
 def _sched_df_pallas_run(qureg, ops: tuple, sched, tile_bits: int,
-                         lk: int, sk: int, lh, sh, ring_depth) -> bool:
+                         lk: int, sk: int, lh, sh, ring_depth,
+                         comm_pipeline=None) -> bool:
     """Explicit-scheduler route for a PallasRun on a sharded PRECISION=2
     register (the ISSUE 3 tentpole): df-split ONCE, run the fused df
     kernels per shard over the scheduler's mesh, and execute the run's
@@ -1740,7 +1754,8 @@ def _sched_df_pallas_run(qureg, ops: tuple, sched, tile_bits: int,
         telemetry.inc("pallas_pass_total", kind="frame_swap")
         planes = sched.apply_frame_permute(
             planes, n=nsv, lo1=tile_bits - lk,
-            lo2=tile_bits if lh is None else lh, k=lk)
+            lo2=tile_bits if lh is None else lh, k=lk,
+            pipeline=comm_pipeline)
     run = _df_shard_chunks(ops, n_local, sublanes, ring_depth=ring_depth)
 
     def body(x):
@@ -1752,7 +1767,8 @@ def _sched_df_pallas_run(qureg, ops: tuple, sched, tile_bits: int,
         telemetry.inc("pallas_pass_total", kind="frame_swap")
         planes = sched.apply_frame_permute(
             planes, n=nsv, lo1=tile_bits - sk,
-            lo2=tile_bits if sh is None else sh, k=sk)
+            lo2=tile_bits if sh is None else sh, k=sk,
+            pipeline=comm_pipeline)
     qureg.put(df_join(planes))
     return True
 
@@ -1897,7 +1913,8 @@ def _apply_dense_block(qureg, U: np.ndarray, qubits: tuple) -> None:
 
 
 def _apply_frame_swap(qureg, tile_bits: int, k: int,
-                      hi: int | None = None) -> None:
+                      hi: int | None = None,
+                      comm_pipeline: int | None = None) -> None:
     """Tape-entry wrapper for FrameSwap: one relabeling transpose. Works on
     every backend (plain XLA); on a sharded register GSPMD lowers it to the
     all-to-all the relabeling implies (shard-local when [hi, hi+k) avoids
@@ -1914,7 +1931,8 @@ def _apply_frame_swap(qureg, tile_bits: int, k: int,
     if sched is not None and sched.mesh is not None and sched.mesh.size > 1:
         qureg.put(sched.apply_frame_permute(
             qureg.amps, n=nsv, lo1=tile_bits - k,
-            lo2=tile_bits if hi is None else hi, k=k))
+            lo2=tile_bits if hi is None else hi, k=k,
+            pipeline=comm_pipeline))
         return
     qureg.put(swap_bit_blocks(qureg.amps, n=nsv, lo1=tile_bits - k,
                               lo2=tile_bits if hi is None else hi, k=k))
@@ -1934,10 +1952,12 @@ def as_tape(p: FusePlan) -> list:
             entries.append((_apply_pallas_run,
                             (item.ops, item.tile_bits, item.load_swap_k,
                              item.store_swap_k, item.load_swap_hi,
-                             item.store_swap_hi, item.ring_depth), {}))
+                             item.store_swap_hi, item.ring_depth,
+                             item.comm_pipeline), {}))
         elif isinstance(item, FrameSwap):
             entries.append((_apply_frame_swap,
-                            (item.tile_bits, item.k, item.hi), {}))
+                            (item.tile_bits, item.k, item.hi,
+                             item.comm_pipeline), {}))
         else:
             entries.append(item)
     return entries

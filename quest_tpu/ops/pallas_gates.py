@@ -113,47 +113,21 @@ _RING_VMEM_BUDGET = 48 * 1024 * 1024
 _RING_ENV_WARNED: set = set()
 
 
-def _ring_env_diagnostic(raw: str, used: int, why: str) -> None:
-    """Flight-record a QT205 diagnostic for a malformed/out-of-range
-    QUEST_PALLAS_RING value (once per distinct raw value): the silent
-    coercion stays -- the kernel must still launch -- but the clamped
-    value is stated via telemetry and a RuntimeWarning."""
-    if raw in _RING_ENV_WARNED:
-        return
-    _RING_ENV_WARNED.add(raw)
-    import warnings
-
-    # deliberate late import: diagnostics depends only on telemetry, so
-    # this cannot cycle back into the ops layer
-    from ..analysis.diagnostics import emit_findings, make_finding
-
-    f = make_finding(
-        "QT205",
-        f"{_RING_ENV}={raw!r} {why}; running with ring depth {used}",
-        f"env:{_RING_ENV}")
-    emit_findings([f])
-    warnings.warn(str(f), RuntimeWarning, stacklevel=3)
-
-
 def ring_depth_default() -> int:
     """The process-wide DMA ring depth: QUEST_PALLAS_RING if set (min 2),
     else _DEF_RING_DEPTH. Malformed or sub-minimum values are coerced as
-    before, but now leave a QT205 diagnostic (warn-once telemetry record
-    stating the clamped value) instead of being swallowed silently."""
-    raw = os.environ.get(_RING_ENV, "").strip()
-    if raw:
-        try:
-            v = int(raw)
-        except ValueError:
-            _ring_env_diagnostic(raw, _DEF_RING_DEPTH,
-                                 "is not an integer")
-            return _DEF_RING_DEPTH
-        if v < 2:
-            _ring_env_diagnostic(raw, 2,
-                                 "is below the 2-slot ring minimum")
-            return 2
-        return v
-    return _DEF_RING_DEPTH
+    before, but leave a QT205 diagnostic (warn-once telemetry record
+    stating the clamped value) instead of being swallowed silently --
+    the shared env-int parser (analysis.diagnostics.parse_env_int, also
+    behind QUEST_COMM_PIPELINE's QT206)."""
+    # deliberate late import: diagnostics depends only on telemetry, so
+    # this cannot cycle back into the ops layer
+    from ..analysis.diagnostics import parse_env_int
+
+    return parse_env_int(_RING_ENV, _DEF_RING_DEPTH, minimum=2,
+                         code="QT205", noun="ring depth",
+                         below="is below the 2-slot ring minimum",
+                         warned=_RING_ENV_WARNED)
 
 
 def effective_ring_depth(ring_depth: int, nchunks: int, slot_bytes: int,

@@ -32,6 +32,26 @@ update, diag/parity phases) stay planar: a df state REJOINS to (2, 2^n)
 f64 via the exact ``pallas_df.df_join`` before any of them runs -- the
 documented hi/lo plane-pair relabeling (both conversions are exact, so the
 round trip costs bandwidth, never precision).
+
+Pipelined collectives (round 8): every launch site here accepts a
+``pipeline`` depth. At depth ``P > 1`` the per-device chunk is split into
+``P`` contiguous power-of-two sub-chunks and the collective is issued as
+``P`` independent sub-collectives interleaved with the per-sub-chunk
+blend/mask/scatter compute -- the prologue issues slice 0's transfer, the
+steady state issues slice k+1 while consuming slice k, and the epilogue
+drains (``_pipeline_schedule``). XLA's latency-hiding scheduler can then
+run slice k's compute while slice k+1's ``ppermute``/``all_to_all`` is in
+flight -- the comm-side twin of the Pallas N-slot DMA ring. Slicing is
+always along the amplitude axis with purely elementwise / slice-local
+compute per sub-chunk, so the pipelined result is BIT-IDENTICAL to the
+monolithic ``P=1`` launch by construction, and the chunk-unit cost model
+(:func:`permute_collective_stats`, scheduler journal pricing) is
+deliberately blind to the depth: pipelining re-times the same traffic, it
+never adds any. Depth resolution: explicit ``pipeline=`` argument, else
+the ``QUEST_COMM_PIPELINE`` env default (:func:`comm_pipeline_default`),
+then one clamp to the site's slice limit (:func:`effective_comm_pipeline`,
+shared with analysis.commcheck exactly like effective_ring_depth is
+shared with ringcheck).
 """
 
 from __future__ import annotations
@@ -51,14 +71,97 @@ from .mesh import local_qubit_count
 
 __all__ = ["dist_apply_matrix1", "dist_apply_x", "dist_apply_diag_phase",
            "dist_apply_parity_phase", "dist_apply_local_matrix", "dist_swap",
-           "dist_permute_bits", "permute_collective_stats"]
+           "dist_permute_bits", "permute_collective_stats",
+           "comm_pipeline_default", "resolve_pipeline",
+           "effective_comm_pipeline"]
 
 
 def _specs(mesh):
     return dict(mesh=mesh, in_specs=P(None, AMP_AXIS), out_specs=P(None, AMP_AXIS))
 
 
-def _launch(kernel, mesh, amps):
+#: env knob for the default comm-pipeline depth (1 = monolithic launch);
+#: overridden per-plan by Circuit.fused(comm_pipeline=) / per-context by
+#: explicit_mesh(comm_pipeline=). Deliberately distinct from the
+#: scheduler's num_slices ICI/DCN split: num_slices partitions the MESH,
+#: the pipeline depth partitions each device's CHUNK.
+_PIPE_ENV = "QUEST_COMM_PIPELINE"
+
+#: monolithic until the on-chip kernelprobe sweep picks a better default
+#: (BASELINE.md documents the sweep recipe); the emulated-CPU tier-1 mesh
+#: cannot measure overlap, so the committed default keeps the exchange
+#: lowering byte-identical to round 7.
+_DEF_COMM_PIPELINE = 1
+
+_PIPE_ENV_WARNED: set = set()
+
+
+def comm_pipeline_default() -> int:
+    """The env-resolved comm-pipeline depth (warn-once QT206 on a
+    malformed ``QUEST_COMM_PIPELINE``, mirroring the ring's QT205)."""
+    from ..analysis.diagnostics import parse_env_int
+    return parse_env_int(_PIPE_ENV, _DEF_COMM_PIPELINE, minimum=1,
+                         code="QT206", noun="pipeline depth",
+                         below="is below the monolithic minimum",
+                         warned=_PIPE_ENV_WARNED)
+
+
+def resolve_pipeline(pipeline) -> int:
+    """Explicit ``pipeline=`` argument if given, else the env default."""
+    return int(pipeline) if pipeline is not None else comm_pipeline_default()
+
+
+def effective_comm_pipeline(depth: int, limit: int, *,
+                            site: str = "exchange") -> int:
+    """The ONE clamp from a requested depth to what a launch site can
+    slice: the largest power of two that is neither above the request nor
+    above ``limit`` (the site's slice count ceiling -- per-device columns
+    for the elementwise kernels, the grouped-view minor axis for
+    all_to_all / odd-parity sends). Pure -- no diagnostics are emitted
+    here; analysis.commcheck re-runs this clamp and reports QT209 when it
+    bites, exactly as ringcheck shares pallas_gates.effective_ring_depth.
+    ``site`` only labels commcheck findings."""
+    depth = max(1, int(depth))
+    depth = 1 << (depth.bit_length() - 1)      # round down to power of two
+    limit = max(1, int(limit))
+    limit = 1 << (limit.bit_length() - 1)
+    return min(depth, limit)
+
+
+def _pipeline_schedule(nslices, transfer, compute, src=None):
+    """Emit the software-pipelined transfer/compute interleaving for
+    ``nslices`` sub-chunks and return the per-slice outputs in order.
+
+    ``transfer(j)`` issues sub-chunk j's collective; ``compute(k, landed)``
+    consumes the landed transfer that output slice k needs, which is
+    transfer ``src(k)`` (identity when the collective does not permute the
+    slice index; dist_apply_x's local hi-bit flips make it an XOR). The
+    emission order is the classic three phases -- prologue issues slice 0's
+    transfer; the steady state issues transfer k+1 BEFORE computing slice k
+    so XLA's latency-hiding scheduler always has the next collective in
+    flight behind the current blend; the epilogue drains the last transfer
+    into the last compute. Every transfer is issued exactly once and
+    consumed exactly once (analysis.commcheck proves the QT207/QT208
+    hazard-freedom of this exact schedule)."""
+    if src is None:
+        src = lambda k: k
+    inflight = {}
+
+    def ensure(j):
+        if j not in inflight:
+            inflight[j] = transfer(j)
+
+    ensure(src(0))                       # prologue: slice 0's transfer
+    outs = []
+    for k in range(nslices):             # steady state + epilogue
+        if k + 1 < nslices:
+            ensure(src(k + 1))           # next transfer in flight ...
+        outs.append(compute(k, inflight.pop(src(k))))  # ... behind compute k
+    assert not inflight                  # epilogue drained
+    return outs
+
+
+def _launch(kernel, mesh, amps, *, kind="collective", pipeline=1):
     """The one launch point for every collective kernel here, threaded
     through the resilience guard (site ``exchange.collective``): a direct
     call when no fault plan is installed; injected transient comm faults
@@ -68,12 +171,37 @@ def _launch(kernel, mesh, amps):
     collective raises a typed QuESTHangError instead of blocking forever
     -- EXCEPT under jit tracing: jax trace state is thread-local, so a
     traced launch must stay on the tracing thread (the compiled
-    execution is covered by the engine-dispatch watchdog instead)."""
+    execution is covered by the engine-dispatch watchdog instead).
+
+    Retry-vs-pipeline contract (round 8): the guard wraps the WHOLE
+    shard_map closure, so at pipeline depth > 1 a transient fault replays
+    the ENTIRE multi-slice launch from the untouched input -- never a
+    resume mid-slice. The kernels are pure (amps -> amps, no donation at
+    this boundary), which is what makes the whole-launch replay
+    bit-identical.
+
+    ``kind``/``pipeline`` label telemetry: the effective depth lands in
+    the ``comm_pipeline_depth`` gauge, and eager (non-traced) launches are
+    wall-timed into the ``comm_collective_ms{kind,pipeline}`` histogram
+    (traced launches fuse into an enclosing jit, so there is no
+    per-collective wall time to observe)."""
+    import time
+
     import jax
 
     from ..resilience import guard
-    return guard.collective(lambda: shard_map(kernel, **_specs(mesh))(amps),
-                            watched=not isinstance(amps, jax.core.Tracer))
+    telemetry.set_gauge("comm_pipeline_depth", int(pipeline))
+    run = lambda: shard_map(kernel, **_specs(mesh))(amps)
+    traced = isinstance(amps, jax.core.Tracer)
+    if traced or not telemetry.enabled():
+        return guard.collective(run, watched=not traced)
+    t0 = time.perf_counter()
+    out = guard.collective(run, watched=True)
+    jax.block_until_ready(out)
+    telemetry.observe("comm_collective_ms",
+                      (time.perf_counter() - t0) * 1e3,
+                      kind=kind, pipeline=int(pipeline))
+    return out
 
 
 def _rank_bit(r, q, nl):
@@ -88,8 +216,14 @@ def _ctrl_pred(r, shard_controls, shard_states, nl):
     return pred
 
 
-def _apply_local_ctrl_mask(own, new, nl, local_controls, local_states):
+def _apply_local_ctrl_mask(own, new, nl, local_controls, local_states,
+                           offset=0):
     """new where all local controls match, else own (flat-iota bit mask).
+
+    ``offset`` is the in-chunk column index of ``own[:, 0]`` -- 0 for a
+    whole-chunk call, ``k * slice_width`` when a pipelined launch masks
+    sub-chunk k (the control bits are tested on the GLOBAL in-chunk index,
+    so a sliced mask composes bit-identically with the monolithic one).
 
     This was a grouped-view ``told.at[idx].set(new[idx])`` until round 6:
     that scatter form MISCOMPILES when two shard_map kernels compose under
@@ -100,7 +234,7 @@ def _apply_local_ctrl_mask(own, new, nl, local_controls, local_states):
     and is immune to the scatter fusion."""
     if not local_controls:
         return new
-    j = lax.iota(jnp.int32, own.shape[1])
+    j = lax.iota(jnp.int32, own.shape[1]) + offset
     ok = jnp.ones(own.shape[1], bool)
     for c, s in zip(local_controls, local_states):
         ok = jnp.logical_and(ok, ((j >> c) & 1) == s)
@@ -122,17 +256,25 @@ def _split_controls(controls, states, nl):
 def dist_apply_matrix1(amps, matrix, *, n: int, target: int,
                        controls: tuple[int, ...] = (),
                        control_states: tuple[int, ...] = (),
-                       conj: bool = False, mesh: Mesh):
+                       conj: bool = False, mesh: Mesh, pipeline=None):
     """U (planar (2,2,2)) on ``target``; the explicit-exchange analogue of
     ops.apply.apply_matrix for one target qubit.
 
-    Sharded target: one ``ppermute`` full-chunk pair exchange + blended
-    update -- identical traffic to the reference's exchangeStateVectors
-    scheme. Local target with (possibly) sharded controls: no communication.
+    Sharded target: ``ppermute`` pair exchange + blended update --
+    identical traffic to the reference's exchangeStateVectors scheme. At
+    ``pipeline`` depth P > 1 the chunk is split into P column slices and
+    each slice's exchange is issued ahead of the previous slice's blend
+    (the blend, control mask and rank predicate are all elementwise, so
+    the sliced launch is bit-identical to the monolithic one). Local
+    target with (possibly) sharded controls: no communication.
     """
     nl = local_qubit_count(n, mesh)
+    eff, kind = 1, "local_matrix"
     if target >= nl:
         telemetry.inc("exchange_calls_total", kind="pair_exchange")
+        eff = effective_comm_pipeline(resolve_pipeline(pipeline), 1 << nl,
+                                      site="pair_exchange")
+        kind = "pair_exchange"
     lc, ls, sc, ss = _split_controls(controls, control_states, nl)
     mr, mi = matrix[0], matrix[1]
     if conj:
@@ -149,32 +291,51 @@ def dist_apply_matrix1(amps, matrix, *, n: int, target: int,
             bitpos = target - nl
             size = mesh.shape[AMP_AXIS]
             perm = [(i, i ^ (1 << bitpos)) for i in range(size)]
-            pair = lax.ppermute(own, AMP_AXIS, perm)
             b = _rank_bit(r, target, nl)
             # new_amp(bit=b) = m[b,b] * own + m[b,1-b] * pair
             m_bb_r, m_bb_i = mr[b, b], mi[b, b]
             m_bo_r, m_bo_i = mr[b, 1 - b], mi[b, 1 - b]
-            re = (m_bb_r * own[0] - m_bb_i * own[1]
-                  + m_bo_r * pair[0] - m_bo_i * pair[1])
-            im = (m_bb_r * own[1] + m_bb_i * own[0]
-                  + m_bo_r * pair[1] + m_bo_i * pair[0])
-            new = jnp.stack([re, im])
-            new = _apply_local_ctrl_mask(own, new, nl, lc, ls)
+
+            def blend(own_s, pair_s, off):
+                re = (m_bb_r * own_s[0] - m_bb_i * own_s[1]
+                      + m_bo_r * pair_s[0] - m_bo_i * pair_s[1])
+                im = (m_bb_r * own_s[1] + m_bb_i * own_s[0]
+                      + m_bo_r * pair_s[1] + m_bo_i * pair_s[0])
+                return _apply_local_ctrl_mask(own_s, jnp.stack([re, im]),
+                                              nl, lc, ls, offset=off)
+
+            if eff == 1:
+                pair = lax.ppermute(own, AMP_AXIS, perm)
+                new = blend(own, pair, 0)
+            else:
+                s = own.shape[1] // eff
+
+                def sl(k):
+                    return lax.slice_in_dim(own, k * s, (k + 1) * s, axis=1)
+
+                new = jnp.concatenate(_pipeline_schedule(
+                    eff,
+                    lambda j: lax.ppermute(sl(j), AMP_AXIS, perm),
+                    lambda k, pair_s: blend(sl(k), pair_s, k * s)), axis=1)
         if sc:
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return _launch(kernel, mesh, amps)
+    return _launch(kernel, mesh, amps, kind=kind, pipeline=eff)
 
 
 def dist_apply_local_matrix(amps, matrix, *, n: int, targets: tuple[int, ...],
                             controls: tuple[int, ...] = (),
                             control_states: tuple[int, ...] = (),
-                            conj: bool = False, mesh: Mesh):
+                            conj: bool = False, mesh: Mesh, pipeline=None):
     """Dense gate whose targets are ALL local: embarrassingly parallel
     shard_map around the single-chunk kernel (the reference's *Local fast
     path, QuEST_cpu_distributed.c:372-377) -- sharded controls become a
     comm-free device-index predicate instead of participating in the kernel.
+
+    ``pipeline`` is accepted for launch-site uniformity but the kernel is
+    comm-free and its GEMM gathers across the whole chunk, so the launch
+    is always monolithic (there is no transfer to overlap).
     """
     nl = local_qubit_count(n, mesh)
     assert all(t < nl for t in targets)
@@ -190,7 +351,7 @@ def dist_apply_local_matrix(amps, matrix, *, n: int, targets: tuple[int, ...],
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return _launch(kernel, mesh, amps)
+    return _launch(kernel, mesh, amps, kind="local_matrix", pipeline=1)
 
 
 # ---------------------------------------------------------------------------
@@ -200,36 +361,79 @@ def dist_apply_local_matrix(amps, matrix, *, n: int, targets: tuple[int, ...],
 def dist_apply_x(amps, *, n: int, targets: tuple[int, ...],
                  controls: tuple[int, ...] = (),
                  control_states: tuple[int, ...] = (),
-                 mesh: Mesh):
+                 mesh: Mesh, pipeline=None):
     """Multi-controlled multi-target NOT: sharded target bits become one
     ``ppermute`` (rank-index XOR), local target bits an in-chunk flip
-    (reference: ctrl-skip exchange, QuEST_cpu_distributed.c:1109-1152)."""
+    (reference: ctrl-skip exchange, QuEST_cpu_distributed.c:1109-1152).
+
+    Pipelined form (depth P > 1, sharded targets present): the chunk is
+    split into P column slices and each slice is exchanged independently.
+    The local target bits split at the slice width -- bits at or above
+    log2(slice) select WHICH transferred slice feeds output slice k (an
+    XOR of the slice index, the ``src`` hook of ``_pipeline_schedule``)
+    while bits below it flip within the slice -- so the permutation the
+    monolithic kernel applies in one piece is reproduced slice-exactly.
+    """
     nl = local_qubit_count(n, mesh)
     lc, ls, sc, ss = _split_controls(controls, control_states, nl)
     local_t = tuple(t for t in targets if t < nl)
     shard_t = tuple(t for t in targets if t >= nl)
+    eff = 1
     if shard_t:
         telemetry.inc("exchange_calls_total", kind="x_permute")
+        eff = effective_comm_pipeline(resolve_pipeline(pipeline), 1 << nl,
+                                      site="x_permute")
 
     def kernel(chunk):
         own = chunk
         r = lax.axis_index(AMP_AXIS)
-        new = own
-        if shard_t:
+        if eff == 1 or not shard_t:
+            new = own
+            if shard_t:
+                mask = 0
+                for t in shard_t:
+                    mask |= 1 << (t - nl)
+                size = mesh.shape[AMP_AXIS]
+                perm = [(i, i ^ mask) for i in range(size)]
+                new = lax.ppermute(new, AMP_AXIS, perm)
+            if local_t:
+                new = K.apply_x_class(new, n=nl, targets=local_t)
+            new = _apply_local_ctrl_mask(own, new, nl, lc, ls)
+        else:
             mask = 0
             for t in shard_t:
                 mask |= 1 << (t - nl)
             size = mesh.shape[AMP_AXIS]
             perm = [(i, i ^ mask) for i in range(size)]
-            new = lax.ppermute(new, AMP_AXIS, perm)
-        if local_t:
-            new = K.apply_x_class(new, n=nl, targets=local_t)
-        new = _apply_local_ctrl_mask(own, new, nl, lc, ls)
+            s = own.shape[1] // eff
+            s_bits = s.bit_length() - 1
+            lo_t = tuple(t for t in local_t if t < s_bits)
+            hi_mask = 0
+            for t in local_t:
+                if t >= s_bits:
+                    hi_mask |= 1 << (t - s_bits)
+
+            def transfer(j):
+                return lax.ppermute(
+                    lax.slice_in_dim(own, j * s, (j + 1) * s, axis=1),
+                    AMP_AXIS, perm)
+
+            def compute(k, recv):
+                new_s = (K.apply_x_class(recv, n=s_bits, targets=lo_t)
+                         if lo_t else recv)
+                own_s = lax.slice_in_dim(own, k * s, (k + 1) * s, axis=1)
+                return _apply_local_ctrl_mask(own_s, new_s, nl, lc, ls,
+                                              offset=k * s)
+
+            new = jnp.concatenate(
+                _pipeline_schedule(eff, transfer, compute,
+                                   src=lambda k: k ^ hi_mask), axis=1)
         if sc:
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return _launch(kernel, mesh, amps)
+    return _launch(kernel, mesh, amps,
+                   kind="x_permute" if shard_t else "local_x", pipeline=eff)
 
 
 # ---------------------------------------------------------------------------
@@ -293,7 +497,7 @@ def permute_collective_stats(n: int, source, mesh: Mesh,
             "collectives": int(rho_src is not None) + int(m > 0)}
 
 
-def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
+def dist_permute_bits(amps, *, n: int, source, mesh: Mesh, pipeline=None):
     """Apply an arbitrary bit permutation of the physical index in at most
     two collectives: ``new_bit[q] = old_bit[source[q]]``.
 
@@ -314,6 +518,15 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
     sharded PRECISION=2 fast path permutes between per-shard kernel runs.
     The permutation is pure data movement on the amplitude axis, so all
     P planes ride the same relabel/all-to-all/transpose natively.
+
+    Pipelined form (depth > 1, crossing bits present): the grouped view's
+    residual minor axis (the 2^(nl-m) columns every crossing piece keeps
+    in place) is split into ``pipeline`` slices and each slice ships as
+    its own grouped ``all_to_all`` -- the all-to-all routing depends only
+    on the major (piece) axis, so per-slice collectives concatenate back
+    bit-exactly, and the df 4-plane layout rides the sliced collective as
+    natively as the monolithic one (the planes axis is untouched). The
+    device-relabel ppermute (a pure re-route) stays monolithic.
     """
     nl = local_qubit_count(n, mesh)
     source = tuple(source)
@@ -324,6 +537,9 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
     m = len(Q_c)
     P = amps.shape[0]
     size = mesh.shape[AMP_AXIS] if mesh is not None and mesh.size > 1 else 1
+    eff = (effective_comm_pipeline(resolve_pipeline(pipeline),
+                                   1 << (nl - m), site="grouped_permute")
+           if m else 1)
 
     if rho_src is not None:
         def relabel(r: int) -> int:
@@ -365,7 +581,24 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
             # piece j (chunk bits at L_in spell j) -> group member whose
             # device bits at Q_c spell j; received concat index j' = the
             # sender's Q_c device bits = the incoming values for L_out
-            t = lax.all_to_all(t, AMP_AXIS, 0, 0, axis_index_groups=groups)
+            if eff == 1:
+                t = lax.all_to_all(t, AMP_AXIS, 0, 0,
+                                   axis_index_groups=groups)
+            else:
+                # routing depends only on the piece (major) axis: slicing
+                # the residual minor axis into eff independent grouped
+                # all_to_alls ships the same bytes to the same peers,
+                # just in overlap-schedulable sub-collectives
+                R = 1 << (nl - m)
+                sR = R // eff
+                t2 = t.reshape((1 << m, P, R))
+                t2 = jnp.concatenate(_pipeline_schedule(
+                    eff,
+                    lambda j: lax.all_to_all(
+                        lax.slice_in_dim(t2, j * sR, (j + 1) * sR, axis=2),
+                        AMP_AXIS, 0, 0, axis_index_groups=groups),
+                    lambda k, got: got), axis=2)
+                t = t2.reshape((1 << m, P) + (2,) * len(rest))
             t = t.reshape((2,) * m + (P,) + (2,) * len(rest))
             src_axis = {}
             for k in range(m):
@@ -384,19 +617,23 @@ def dist_permute_bits(amps, *, n: int, source, mesh: Mesh):
     if mesh is None or mesh.size == 1:
         assert m == 0 and rho_src is None
         return kernel(amps)
-    return _launch(kernel, mesh, amps)
+    return _launch(kernel, mesh, amps, kind="grouped_permute", pipeline=eff)
 
 def dist_apply_diag_phase(amps, diag, *, n: int, targets: tuple[int, ...],
                           controls: tuple[int, ...] = (),
                           control_states: tuple[int, ...] = (),
-                          conj: bool = False, mesh: Mesh):
+                          conj: bool = False, mesh: Mesh, pipeline=None):
     """diag (planar (2, 2^t)) applied to ``targets``; entry index bit k is
     targets[k]'s bit. Phases depend only on index bits, so sharded qubits
     contribute a per-device scalar offset into the diagonal -- no traffic at
     all (the reference's phase kernels are likewise exchange-free,
-    QuEST_cpu.c:3235-3285)."""
+    QuEST_cpu.c:3235-3285). At ``pipeline`` depth P > 1 the (comm-free,
+    purely elementwise) phase is emitted in P column slices so XLA can
+    interleave it with any in-flight neighbouring collective."""
     nl = local_qubit_count(n, mesh)
     lc, ls, sc, ss = _split_controls(controls, control_states, nl)
+    eff = effective_comm_pipeline(resolve_pipeline(pipeline), 1 << nl,
+                                  site="diag_phase")
     dr, di = diag[0], diag[1]
     if conj:
         di = -di
@@ -404,68 +641,91 @@ def dist_apply_diag_phase(amps, diag, *, n: int, targets: tuple[int, ...],
     def kernel(chunk):
         own = chunk
         r = lax.axis_index(AMP_AXIS)
-        C = own.shape[1]
-        j = lax.iota(jnp.int32, C)
-        idx = jnp.zeros((), jnp.int32)
-        for k, t in enumerate(targets):
-            if t < nl:
-                bit = (j >> t) & 1
-            else:
-                bit = _rank_bit(r, t, nl).astype(jnp.int32)
-            idx = idx + (bit << k)
-        fr, fi = dr[idx], di[idx]
-        re = fr * own[0] - fi * own[1]
-        im = fr * own[1] + fi * own[0]
-        new = jnp.stack([re, im])
-        new = _apply_local_ctrl_mask(own, new, nl, lc, ls)
+
+        def phase(own_s, off):
+            j = lax.iota(jnp.int32, own_s.shape[1]) + off
+            idx = jnp.zeros((), jnp.int32)
+            for k, t in enumerate(targets):
+                if t < nl:
+                    bit = (j >> t) & 1
+                else:
+                    bit = _rank_bit(r, t, nl).astype(jnp.int32)
+                idx = idx + (bit << k)
+            fr, fi = dr[idx], di[idx]
+            re = fr * own_s[0] - fi * own_s[1]
+            im = fr * own_s[1] + fi * own_s[0]
+            return _apply_local_ctrl_mask(own_s, jnp.stack([re, im]),
+                                          nl, lc, ls, offset=off)
+
+        if eff == 1:
+            new = phase(own, 0)
+        else:
+            s = own.shape[1] // eff
+            new = jnp.concatenate(
+                [phase(lax.slice_in_dim(own, k * s, (k + 1) * s, axis=1),
+                       k * s) for k in range(eff)], axis=1)
         if sc:
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return _launch(kernel, mesh, amps)
+    return _launch(kernel, mesh, amps, kind="diag_phase", pipeline=eff)
 
 
 def dist_apply_parity_phase(amps, theta, *, n: int, qubits: tuple[int, ...],
                             controls: tuple[int, ...] = (),
                             control_states: tuple[int, ...] = (),
-                            conj: bool = False, mesh: Mesh):
+                            conj: bool = False, mesh: Mesh, pipeline=None):
     """exp(-i theta/2 Z x...x Z): comm-free; sharded qubits fold their bit
     into the device-index parity (reference mask-parity kernel
-    QuEST_cpu.c:3235-3285 -- likewise exchange-free)."""
+    QuEST_cpu.c:3235-3285 -- likewise exchange-free). At ``pipeline``
+    depth P > 1 the elementwise sign flip is emitted in P column slices,
+    as :func:`dist_apply_diag_phase`."""
     nl = local_qubit_count(n, mesh)
     lc, ls, sc, ss = _split_controls(controls, control_states, nl)
+    eff = effective_comm_pipeline(resolve_pipeline(pipeline), 1 << nl,
+                                  site="parity_phase")
     local_q = [q for q in qubits if q < nl]
     shard_q = [q for q in qubits if q >= nl]
 
     def kernel(chunk):
         own = chunk
         r = lax.axis_index(AMP_AXIS)
-        C = own.shape[1]
-        j = lax.iota(jnp.int32, C)
-        par = jnp.zeros((), jnp.int32)
-        for q in local_q:
-            par = par ^ ((j >> q) & 1)
-        for q in shard_q:
-            par = par ^ _rank_bit(r, q, nl).astype(jnp.int32)
-        sign = (1 - 2 * par).astype(own.dtype)
-        th = jnp.asarray(-theta if conj else theta, dtype=own.dtype)
-        fr, fi = jnp.cos(th / 2), -jnp.sin(th / 2) * sign
-        re = fr * own[0] - fi * own[1]
-        im = fr * own[1] + fi * own[0]
-        new = jnp.stack([re, im])
-        new = _apply_local_ctrl_mask(own, new, nl, lc, ls)
+
+        def phase(own_s, off):
+            j = lax.iota(jnp.int32, own_s.shape[1]) + off
+            par = jnp.zeros((), jnp.int32)
+            for q in local_q:
+                par = par ^ ((j >> q) & 1)
+            for q in shard_q:
+                par = par ^ _rank_bit(r, q, nl).astype(jnp.int32)
+            sign = (1 - 2 * par).astype(own_s.dtype)
+            th = jnp.asarray(-theta if conj else theta, dtype=own_s.dtype)
+            fr, fi = jnp.cos(th / 2), -jnp.sin(th / 2) * sign
+            re = fr * own_s[0] - fi * own_s[1]
+            im = fr * own_s[1] + fi * own_s[0]
+            return _apply_local_ctrl_mask(own_s, jnp.stack([re, im]),
+                                          nl, lc, ls, offset=off)
+
+        if eff == 1:
+            new = phase(own, 0)
+        else:
+            s = own.shape[1] // eff
+            new = jnp.concatenate(
+                [phase(lax.slice_in_dim(own, k * s, (k + 1) * s, axis=1),
+                       k * s) for k in range(eff)], axis=1)
         if sc:
             new = jnp.where(_ctrl_pred(r, sc, ss, nl), new, own)
         return new
 
-    return _launch(kernel, mesh, amps)
+    return _launch(kernel, mesh, amps, kind="parity_phase", pipeline=eff)
 
 
 # ---------------------------------------------------------------------------
 # qubit-amplitude swap (the relocation primitive)
 # ---------------------------------------------------------------------------
 
-def dist_swap(amps, *, n: int, qb1: int, qb2: int, mesh: Mesh):
+def dist_swap(amps, *, n: int, qb1: int, qb2: int, mesh: Mesh,
+              pipeline=None):
     """SWAP(qb1, qb2). Three regimes, as the reference (:1424-1459):
 
     - both local: in-chunk axis transposition;
@@ -477,13 +737,22 @@ def dist_swap(amps, *, n: int, qb1: int, qb2: int, mesh: Mesh):
     The sharded regimes are pure data movement and carry any leading plane
     count (planar pair or the df 4-plane layout); the both-local regime
     routes through the planar apply_swap kernel and takes (2, N) only.
+
+    Pipelined form (depth P > 1): the both-sharded ppermute slices the
+    chunk columns; the odd-parity exchange slices the grouped view's
+    MAJOR axis (the 2^(nl-1-lo) blocks above the swapped local bit), so
+    each slice's send/recv/reassemble is independent and the per-slice
+    stacks concatenate back bit-exactly.
     """
     nl = local_qubit_count(n, mesh)
     lo, hi = min(qb1, qb2), max(qb1, qb2)
+    eff, kind = 1, "swap_local"
     if hi >= nl:
-        telemetry.inc("exchange_calls_total",
-                      kind=("swap_rank_permute" if lo >= nl
-                            else "swap_odd_parity"))
+        kind = "swap_rank_permute" if lo >= nl else "swap_odd_parity"
+        telemetry.inc("exchange_calls_total", kind=kind)
+        limit = (1 << nl) if lo >= nl else (1 << (nl - 1 - lo))
+        eff = effective_comm_pipeline(resolve_pipeline(pipeline), limit,
+                                      site=kind)
 
     def kernel(chunk):
         own = chunk
@@ -499,7 +768,15 @@ def dist_swap(amps, *, n: int, qb1: int, qb2: int, mesh: Mesh):
                 return i ^ (((x ^ y) << b1) | ((x ^ y) << b2))
 
             perm = [(i, swap_bits(i)) for i in range(size)]
-            return lax.ppermute(own, AMP_AXIS, perm)
+            if eff == 1:
+                return lax.ppermute(own, AMP_AXIS, perm)
+            s = own.shape[1] // eff
+            return jnp.concatenate(_pipeline_schedule(
+                eff,
+                lambda j: lax.ppermute(
+                    lax.slice_in_dim(own, j * s, (j + 1) * s, axis=1),
+                    AMP_AXIS, perm),
+                lambda k, recv: recv), axis=1)
 
         # mixed: lo local, hi sharded
         bitpos = hi - nl
@@ -513,12 +790,32 @@ def dist_swap(amps, *, n: int, qb1: int, qb2: int, mesh: Mesh):
         sub0 = lax.index_in_dim(t, 0, axis=ax, keepdims=False)
         sub1 = lax.index_in_dim(t, 1, axis=ax, keepdims=False)
         send = jnp.where(b == 0, sub1, sub0)       # local bit != device bit
-        recv = lax.ppermute(send, AMP_AXIS, perm)  # partner's odd-parity half
         keep = jnp.where(b == 0, sub0, sub1)
-        # reassemble: slot (local bit == b) keeps own, other slot gets recv
-        new0 = jnp.where(b == 0, keep, recv)
-        new1 = jnp.where(b == 0, recv, keep)
-        new = jnp.stack([new0, new1], axis=ax)
+
+        def reassemble(send_s, keep_s):
+            recv = lax.ppermute(send_s, AMP_AXIS, perm)  # partner's half
+            # slot (local bit == b) keeps own, other slot gets recv
+            new0 = jnp.where(b == 0, keep_s, recv)
+            new1 = jnp.where(b == 0, recv, keep_s)
+            return jnp.stack([new0, new1], axis=ax)
+
+        if eff == 1:
+            new = reassemble(send, keep)
+        else:
+            # slice the A (major-block) axis of the (P, A, B) halves; each
+            # sub-block's exchange + reassembly is independent
+            sA = send.shape[1] // eff
+
+            def sl(x, k):
+                return lax.slice_in_dim(x, k * sA, (k + 1) * sA, axis=1)
+
+            new = jnp.concatenate(_pipeline_schedule(
+                eff,
+                lambda j: lax.ppermute(sl(send, j), AMP_AXIS, perm),
+                lambda k, recv: jnp.stack(
+                    [jnp.where(b == 0, sl(keep, k), recv),
+                     jnp.where(b == 0, recv, sl(keep, k))], axis=ax)),
+                axis=1)
         return new.reshape(own.shape)
 
-    return _launch(kernel, mesh, amps)
+    return _launch(kernel, mesh, amps, kind=kind, pipeline=eff)

@@ -111,6 +111,15 @@ class DistributedScheduler:
     #: a-time policy (for A/B plan stats; the batched grouped permute is
     #: the production path -- see :meth:`_relocate`)
     batch_relocations: bool = True
+    #: comm-pipeline depth every collective launch runs at (None = the
+    #: QUEST_COMM_PIPELINE env default; 1 = monolithic). Pipelining only
+    #: re-times traffic -- the chunk-unit pricing above is identical at
+    #: every depth (check_schedule proves it from the journal stamp) --
+    #: so this knob never changes any scheduling decision, only how each
+    #: launched collective is sliced. Deliberately distinct from
+    #: ``num_slices``: that splits the MESH into ICI/DCN slices, this
+    #: splits each device's CHUNK into overlappable sub-transfers.
+    comm_pipeline: int | None = None
     stats: dict = field(default_factory=lambda: {
         "pair_exchanges": 0, "relocation_swaps": 0, "rank_permutes": 0,
         "comm_free": 0, "local": 0, "channel_superops": 0,
@@ -132,12 +141,21 @@ class DistributedScheduler:
     #:   | ("virtual_swap", p1, p2) | ("reconcile_swap", n, a, b)
     #:   | ("permute", n, source, unit_scale, kind)
     #:   | ("reconcile_done", n)
+    #: plus one leading ("comm_pipeline", depth) stamp recording the
+    #: resolved pipeline depth the plan's collectives launch at (priced at
+    #: ZERO chunk-units by check_schedule: the proof that pipelining
+    #: leaves the model unchanged)
     #: -- enough to re-price the whole plan and replay the layout
     #: independently. None (the default) records nothing.
     journal: list | None = None
 
     def _note(self, *rec) -> None:
         if self.journal is not None:
+            if not self.journal:
+                # stamped lazily at the first record: plan_circuit attaches
+                # the journal list after construction
+                self.journal.append(
+                    ("comm_pipeline", X.resolve_pipeline(self.comm_pipeline)))
             self.journal.append(rec)
 
     def _count_comm(self, n: int, qubit: int, chunks: float,
@@ -312,7 +330,8 @@ class DistributedScheduler:
                 else:
                     self.stats["local"] += 1
                 self._note("reconcile_swap", n, a, b)
-                amps = X.dist_swap(amps, n=n, qb1=a, qb2=b, mesh=self.mesh)
+                amps = X.dist_swap(amps, n=n, qb1=a, qb2=b, mesh=self.mesh,
+                                    pipeline=self.comm_pipeline)
                 self._swap_positions(a, b)
             self._note("reconcile_done", n)
             return amps
@@ -336,13 +355,14 @@ class DistributedScheduler:
                 self._count_comm(n, q, 2.0 / len(moved),
                                  kind="reconciliation")
         self._note("permute", n, source, 1.0, "reconciliation")
-        amps = X.dist_permute_bits(amps, n=n, source=source, mesh=self.mesh)
+        amps = X.dist_permute_bits(amps, n=n, source=source, mesh=self.mesh,
+                                   pipeline=self.comm_pipeline)
         self._pos = list(range(n))
         self._occ = list(range(n))
         self._note("reconcile_done", n)
         return amps
 
-    def apply_frame_permute(self, amps, *, n, lo1, lo2, k):
+    def apply_frame_permute(self, amps, *, n, lo1, lo2, k, pipeline=None):
         """One pallas frame transpose -- the bit-block swap
         [lo1, lo1+k) <-> [lo2, lo2+k) -- executed as the COUNTED grouped
         permute collective (exchange.dist_permute_bits) instead of an
@@ -379,7 +399,9 @@ class DistributedScheduler:
                 self._count_comm(n, q, 2.0 * scale / len(moved),
                                  kind="frame_transpose")
         self._note("permute", n, source, scale, "frame_transpose")
-        return X.dist_permute_bits(amps, n=n, source=source, mesh=self.mesh)
+        return X.dist_permute_bits(
+            amps, n=n, source=source, mesh=self.mesh,
+            pipeline=pipeline if pipeline is not None else self.comm_pipeline)
 
     def _pending_shard_uses(self, n, nl, exclude, capacity) -> list:
         """Sharded physical positions that tape entries between the cursor
@@ -520,7 +542,8 @@ class DistributedScheduler:
                 self._note("permute", n, tuple(source), 1.0,
                            "relocation_batch")
                 amps = X.dist_permute_bits(amps, n=n, source=tuple(source),
-                                           mesh=self.mesh)
+                                           mesh=self.mesh,
+                                           pipeline=self.comm_pipeline)
                 for s, f in pairs:
                     self._swap_positions(f, s)
                 return amps, {s: f for s, f in pairs if s in set(shard)}
@@ -529,7 +552,8 @@ class DistributedScheduler:
             self.stats["relocation_swaps"] += 1
             self._count_comm(n, s, 1.0, kind="dist_swap")
             self._note("dist_swap", n, f, s, self.deferring)
-            amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh)
+            amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh,
+                               pipeline=self.comm_pipeline)
             if self.deferring:
                 self._swap_positions(f, s)
             relocation[s] = f
@@ -549,7 +573,7 @@ class DistributedScheduler:
             return X.dist_apply_local_matrix(
                 amps, matrix, n=n, targets=p_targets,
                 controls=p_controls, control_states=tuple(control_states),
-                conj=conj, mesh=self.mesh)
+                conj=conj, mesh=self.mesh, pipeline=self.comm_pipeline)
         support = set(p_targets) | set(p_controls)
         if len(targets) == 1:
             # the reference's policy: full-chunk pair exchange per gate
@@ -570,14 +594,14 @@ class DistributedScheduler:
                     amps, matrix, n=n, target=p_targets[0],
                     controls=p_controls,
                     control_states=tuple(control_states), conj=conj,
-                    mesh=self.mesh)
+                    mesh=self.mesh, pipeline=self.comm_pipeline)
             self.stats["local"] += 1
             return X.dist_apply_local_matrix(
                 amps, matrix, n=n,
                 targets=tuple(relocation.get(t, t) for t in p_targets),
                 controls=tuple(relocation.get(c, c) for c in p_controls),
                 control_states=tuple(control_states), conj=conj,
-                mesh=self.mesh)
+                mesh=self.mesh, pipeline=self.comm_pipeline)
         # relocate sharded targets to free local slots, apply locally;
         # immediate mode swaps back (reference :1526-1568), deferred mode
         # leaves the new layout in place
@@ -587,13 +611,15 @@ class DistributedScheduler:
         self.stats["local"] += 1
         amps = X.dist_apply_local_matrix(
             amps, matrix, n=n, targets=new_targets, controls=new_controls,
-            control_states=tuple(control_states), conj=conj, mesh=self.mesh)
+            control_states=tuple(control_states), conj=conj, mesh=self.mesh,
+            pipeline=self.comm_pipeline)
         if not self.deferring:
             for s, f in relocation.items():
                 self.stats["relocation_swaps"] += 1
                 self._count_comm(n, s, 1.0, kind="dist_swap")
                 self._note("dist_swap", n, f, s, False)
-                amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh)
+                amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh,
+                                   pipeline=self.comm_pipeline)
         return amps
 
     # -- permutation class --------------------------------------------------
@@ -608,7 +634,8 @@ class DistributedScheduler:
             return X.dist_apply_x(amps, n=n, targets=p_targets,
                                   controls=p_controls,
                                   control_states=tuple(control_states),
-                                  mesh=self.mesh)
+                                  mesh=self.mesh,
+                                  pipeline=self.comm_pipeline)
         relocation = None
         if self.deferring:
             # relocate sharded X targets too: a rank permute re-routes the
@@ -631,7 +658,7 @@ class DistributedScheduler:
         return X.dist_apply_x(amps, n=n, targets=p_targets,
                               controls=p_controls,
                               control_states=tuple(control_states),
-                              mesh=self.mesh)
+                              mesh=self.mesh, pipeline=self.comm_pipeline)
 
     def apply_swap(self, amps, *, n, qb1, qb2):
         self._touch((qb1, qb2))
@@ -659,7 +686,8 @@ class DistributedScheduler:
             self.stats["relocation_swaps"] += 1
             self._count_comm(n, max(p1, p2), 1.0, kind="dist_swap")
             self._note("dist_swap", n, p1, p2, False)
-        return X.dist_swap(amps, n=n, qb1=p1, qb2=p2, mesh=self.mesh)
+        return X.dist_swap(amps, n=n, qb1=p1, qb2=p2, mesh=self.mesh,
+                           pipeline=self.comm_pipeline)
 
     # -- diagonal family (always comm-free) ---------------------------------
 
@@ -681,7 +709,8 @@ class DistributedScheduler:
         return X.dist_apply_diag_phase(
             amps, diag, n=n, targets=self._map(n, targets),
             controls=self._map(n, controls),
-            control_states=tuple(control_states), conj=conj, mesh=self.mesh)
+            control_states=tuple(control_states), conj=conj, mesh=self.mesh,
+            pipeline=self.comm_pipeline)
 
     def apply_parity_phase(self, amps, theta, *, n, qubits, controls=(),
                            control_states=(), conj=False):
@@ -690,18 +719,23 @@ class DistributedScheduler:
         return X.dist_apply_parity_phase(
             amps, theta, n=n, qubits=self._map(n, qubits),
             controls=self._map(n, controls),
-            control_states=tuple(control_states), conj=conj, mesh=self.mesh)
+            control_states=tuple(control_states), conj=conj, mesh=self.mesh,
+            pipeline=self.comm_pipeline)
 
 
 @contextmanager
 def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True,
                   collective_reconcile: bool = True,
-                  batch_relocations: bool = True):
+                  batch_relocations: bool = True,
+                  comm_pipeline: int | None = None):
     """Route L5 gate application through the explicit shard_map kernels.
     ``num_slices`` > 1 splits the plan's comm stats into ICI vs DCN chunks
     (slice-major device order; parallel.mesh.shard_bit_link).
     ``batch_relocations=False`` forces the per-swap relocation policy
-    (A/B against the round-6 grouped-permute batching)."""
+    (A/B against the round-6 grouped-permute batching).
+    ``comm_pipeline`` sets the collective pipeline depth every exchange
+    launch in the context runs at (None = the QUEST_COMM_PIPELINE env
+    default, 1 = monolithic; bit-identical at every depth)."""
     from ..environment import AMP_AXIS
     if mesh is not None and mesh.size > 1 and AMP_AXIS not in mesh.shape:
         raise ValueError(
@@ -711,7 +745,8 @@ def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True,
     sched = (DistributedScheduler(mesh, num_slices=num_slices,
                                   allow_defer=defer,
                                   collective_reconcile=collective_reconcile,
-                                  batch_relocations=batch_relocations)
+                                  batch_relocations=batch_relocations,
+                                  comm_pipeline=comm_pipeline)
              if mesh is not None and mesh.size > 1 else None)
     prev = getattr(_STATE, "sched", None)
     _STATE.sched = sched
@@ -745,7 +780,8 @@ def comm_chunks(stats: dict) -> float:
 def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
                  defer: bool = True, collective_reconcile: bool = True,
                  batch_relocations: bool = True, dtype=None,
-                 journal: list | None = None):
+                 journal: list | None = None,
+                 comm_pipeline: int | None = None):
     """Trace ``circuit`` abstractly under the explicit scheduler and return
     its communication plan stats (no device execution -- jax.eval_shape).
     ``dtype`` sets the abstract register's amplitude dtype (default: the
@@ -753,7 +789,10 @@ def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
     double-float route prices its frame transposes at the df 2x chunk-unit
     scale, exactly as the executed replay counts them. ``journal`` (a
     caller-owned list) additionally records every communication decision
-    for the static verifier (see DistributedScheduler.journal)."""
+    for the static verifier (see DistributedScheduler.journal);
+    ``comm_pipeline`` stamps the resolved collective pipeline depth into
+    that journal (pricing is depth-invariant -- check_schedule proves
+    it)."""
     import jax
     import numpy as np
 
@@ -768,7 +807,8 @@ def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
     num_amps = 1 << nsv
     with explicit_mesh(mesh, num_slices=num_slices, defer=defer,
                        collective_reconcile=collective_reconcile,
-                       batch_relocations=batch_relocations) as sched:
+                       batch_relocations=batch_relocations,
+                       comm_pipeline=comm_pipeline) as sched:
         if sched is not None and journal is not None:
             sched.journal = journal
         fn = circuit.as_fn()

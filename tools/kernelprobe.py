@@ -11,6 +11,11 @@ run, not from subtracting separately-measured floors):
      model's _op_cost_ms),
   3. the bf16x3 zone-dot costs (lane_u, window) the fold thresholds
      compare against.
+
+Round 8 adds the comm-pipeline sweep (multi-device hosts only): every
+pipelined collective kind x depth {1,2,4,8}, with each eager launch
+self-observing into the ``comm_collective_ms{kind,pipeline}`` histogram
+so the BASELINE.md table regenerates from telemetry alone.
 """
 
 from __future__ import annotations
@@ -51,6 +56,60 @@ def timeit(fn, amps, label, reps=10, trials=3):
         best = min(best, (time.perf_counter() - t0) / reps)
     print(f"{label:30s} {best * 1e3:8.3f} ms")
     return amps, best
+
+
+def comm_sweep(n):
+    """Pipeline-depth x collective-kind sweep (ISSUE 10 operating point).
+
+    Times each pipelined launch site eagerly at depths {1,2,4,8}; the
+    launch point (`exchange._launch`) self-observes every eager call into
+    the ``comm_collective_ms{kind,pipeline}`` histogram, so the committed
+    BASELINE.md table regenerates from telemetry alone. Skipped on
+    single-device hosts (no collective to overlap).
+    """
+    ndev = 1 << (jax.device_count().bit_length() - 1)
+    if ndev < 2:
+        print("# comm sweep skipped: single device")
+        return
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from quest_tpu import telemetry
+    from quest_tpu.parallel import exchange as X
+
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:ndev]), (X.AMP_AXIS,))
+    sharding = NamedSharding(mesh, P(None, X.AMP_AXIS))
+    amps = jax.device_put(
+        jnp.zeros((2, 1 << n), jnp.float32).at[0, 0].set(1.0), sharding)
+    # device array: the pair-exchange kernel indexes the planar matrix
+    # with a traced rank bit
+    H = jnp.asarray(np.stack([np.array([[1.0, 1.0], [1.0, -1.0]])
+                              / np.sqrt(2), np.zeros((2, 2))]), jnp.float32)
+    cross = list(range(n))
+    cross[0], cross[n - 1] = cross[n - 1], cross[0]
+    kinds = {
+        "pair_exchange": lambda a, p: X.dist_apply_matrix1(
+            a, H, n=n, target=n - 1, mesh=mesh, pipeline=p),
+        "x_permute": lambda a, p: X.dist_apply_x(
+            a, n=n, targets=(n - 1, 0), mesh=mesh, pipeline=p),
+        "grouped_permute": lambda a, p: X.dist_permute_bits(
+            a, n=n, source=tuple(cross), mesh=mesh, pipeline=p),
+        "swap_odd_parity": lambda a, p: X.dist_swap(
+            a, n=n, qb1=n - 1, qb2=0, mesh=mesh, pipeline=p),
+    }
+    if ndev >= 4:
+        kinds["swap_rank_permute"] = lambda a, p: X.dist_swap(
+            a, n=n, qb1=n - 1, qb2=n - 2, mesh=mesh, pipeline=p)
+    for kind, fn in kinds.items():
+        for depth in (1, 2, 4, 8):
+            jax.block_until_ready(fn(amps, depth))  # warm the compile cache
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(amps, depth))
+                best = min(best, time.perf_counter() - t0)
+            print(f"comm {kind:18s} P={depth} {best * 1e3:8.3f} ms")
+    print("# comm sweep histograms:",
+          telemetry.snapshot("comm_collective_ms")["histograms"])
 
 
 def main():
@@ -100,6 +159,9 @@ def main():
                                   nsv=n, ring=ring, sublanes=s, mix=label)
     print("# ring sweep histograms:",
           telemetry.snapshot("pallas_per_pass_ms")["histograms"])
+
+    # --- comm-pipeline depth x collective-kind sweep (ISSUE 10) ---------
+    comm_sweep(n)
 
     # --- folded-swap DMA overheads (at the default S) -------------------
     # guard: a k-bit swap needs k grid bits above the tile (hi + k <= n)
