@@ -875,6 +875,129 @@ def bench_serving(n: int, depth: int, reps: int) -> dict:
     }
 
 
+def trajectory_circuit(n: int):
+    """The trajectories_20q noisy circuit: an entangled n-qubit base with
+    one channel site from each built-in family (depolarising, damping,
+    two-qubit dephasing, Pauli) -- recorded as a density tape; the bench
+    unravels it into the stochastic pure-state form."""
+    from quest_tpu.circuits import Circuit
+
+    circ = Circuit(n, is_density_matrix=True)
+    for q in range(n):
+        circ.hadamard(q)
+    for q in range(0, n - 1, 2):
+        circ.controlledNot(q, q + 1)
+    circ.mixDepolarising(1, 0.05)
+    circ.rotateY(n // 2, 0.9)
+    circ.mixDamping(0, 0.1)
+    circ.mixTwoQubitDephasing(2, 5, 0.2)
+    circ.rotateX(1, -0.4)
+    circ.mixPauli(3, 0.02, 0.03, 0.05)
+    return circ
+
+
+def bench_trajectories(n: int, t: int, reps: int) -> dict:
+    """CI-gate config ``trajectories_20q``: quantum-trajectory unraveling
+    throughput -- T stochastic pure-state trajectories of a noisy n-qubit
+    circuit run as ONE compiled executable replayed over T seed streams
+    (the engine's vmap-over-params batcher, seeds as uint32 slots). The
+    density route for the same circuit at n qubits would cost 2n qubits
+    of state; the anchor row compares channel-site throughput against the
+    density14 reference instead. The workflow gate asserts the two
+    correctness invariants alongside the rate: ``ensemble_mean_ok`` (the
+    6q ensemble mean matches the density-matrix oracle within the
+    4/sqrt(T) band) and ``seed_replay_bitident`` (the same seed list
+    replays the n-qubit ensemble bit-identically)."""
+    import time
+
+    import jax
+
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu import trajectories as traj
+
+    env = qt.createQuESTEnv(jax.devices()[:1])
+
+    # correctness leg 1: 6q ensemble mean vs the exact density oracle
+    t_small = max(t, 128)
+    small = trajectory_circuit(6)
+    dm = qt.createDensityQureg(6, env)
+    small.run(dm)
+    rho = qt.get_np(dm).reshape(64, 64).T  # flat layout is [col, row]
+    res = traj.run_ensemble(small, t_small, env=env, base_seed=11)
+    mean_err = float(np.max(np.abs(res.density() - rho)))
+    mean_tol = 4.0 / np.sqrt(t_small)
+    mean_ok = bool(mean_err < mean_tol)
+
+    # the timed leg: T n-qubit trajectories through one executable
+    circ = traj.unravel(trajectory_circuit(n))
+    sites = sum(1 for fn, _, _ in circ._tape
+                if getattr(fn, "__name__", "") == "applyTrajectoryKraus")
+    seeds = list(range(100, 100 + t))
+    t0 = time.perf_counter()
+    first = traj.run_ensemble(circ, env=env, seeds=seeds, max_batch=t)
+    cold_s = time.perf_counter() - t0
+    tr0 = telemetry.counter_value("engine_trace_total", kind="param_replay")
+    best = float("inf")
+    last = first
+    for _ in range(max(reps, 1)):
+        t1 = time.perf_counter()
+        last = traj.run_ensemble(circ, env=env, seeds=seeds, max_batch=t)
+        best = min(best, time.perf_counter() - t1)
+    # correctness leg 2: the fixed seed list replayed bit-identically at
+    # the bench size (warm engines serve the SAME cached executable, so
+    # this also pins the cache path); warm runs must never retrace
+    bitident = bool(np.array_equal(first.states, last.states))
+    warm_retraces = int(telemetry.counter_value(
+        "engine_trace_total", kind="param_replay") - tr0)
+    # correctness leg 3: the same fixed seeds replay the n-qubit run
+    # bit-identically on the full (8-virtual-device) mesh -- the sharded
+    # engine replays lanes sequentially with donated buffers, so this
+    # pins the acceptance contract beyond density-matrix reach
+    mesh_devices = jax.device_count()
+    mesh_bitident = None
+    if mesh_devices >= 2:
+        env_mesh = qt.createQuESTEnv(jax.devices())
+        ma = traj.run_ensemble(circ, env=env_mesh, seeds=seeds[:2],
+                               max_batch=2)
+        mb = traj.run_ensemble(circ, env=env_mesh, seeds=seeds[:2],
+                               max_batch=2)
+        mesh_bitident = bool(np.array_equal(ma.states, mb.states))
+    traj_per_sec = t / best
+    site_rate = sites * traj_per_sec
+    ref = REF_DENSITY_CHANNEL_OPS_PER_SEC.get((14, "r4"))
+    return {
+        "config": "trajectories_20q",
+        "metric": f"trajectories/sec, {n}q noisy circuit ({sites} channel "
+                  f"sites) as one batch-{t} vmap ensemble at state-vector "
+                  "cost",
+        "value": round(traj_per_sec, 2),
+        "unit": "traj/sec",
+        "vs_baseline": round(site_rate / ref, 2) if ref else None,
+        "detail": {
+            "qubits": n,
+            "num_trajectories": t,
+            "channel_sites": sites,
+            "ensemble_mean_ok": mean_ok,
+            "ensemble_mean_err": round(mean_err, 4),
+            "ensemble_mean_tol": round(mean_tol, 4),
+            "ensemble_mean_trajectories": t_small,
+            "seed_replay_bitident": bitident,
+            "mesh_devices": mesh_devices,
+            "mesh_replay_bitident": mesh_bitident,
+            "warm_retraces": warm_retraces,
+            "cold_ensemble_ms": round(cold_s * 1e3, 1),
+            "warm_ensemble_ms": round(best * 1e3, 2),
+            "channel_sites_per_sec": round(site_rate, 2),
+            "density14_anchor_ops_per_sec": ref,
+            "vs_baseline_note": "channel-sites/sec over the density14 r4 "
+                                "anchor: trajectory sites at 20q (2^20 "
+                                "amps/lane) vs density channel ops at 14q "
+                                "(2^28 amps)",
+        },
+    }
+
+
 def bench_resilience(n: int, depth: int, reps: int) -> dict:
     """CI-gate config ``resilience_20q``: what arming the resilience layer
     (ISSUE 7) costs on the serving path. Injection sites live at TRACE
@@ -1223,6 +1346,27 @@ def _comm_config(reps: int, smoke: bool) -> dict:
                "the explicit scheduler (monolithic vs depth-4)")
 
 
+def _trajectories_config(reps: int, smoke: bool) -> dict:
+    """Run the trajectories_20q row, re-execing into an 8-virtual-device
+    subprocess when this process's backend has a single device, so the
+    mesh-replay leg (fixed seeds bit-identical on the sharded route at
+    20q) runs even on single-device CI hosts -- the ``_comm_config``
+    pattern."""
+    import jax
+
+    if jax.device_count() >= 2 or "_QUEST_TRAJ_SUBPROC" in os.environ:
+        return bench_trajectories(20, 8 if smoke else 16, reps)
+    flags = (os.environ.get("XLA_FLAGS", "")
+             + " --xla_force_host_platform_device_count=8").strip()
+    return _subprocess_config(
+        ["--config", "trajectories", "--reps", str(reps)]
+        + (["--smoke"] if smoke else []),
+        env={"XLA_FLAGS": flags, "_QUEST_TRAJ_SUBPROC": "1"},
+        budget_s=1800, unit="traj/sec", slug="trajectories_20q",
+        metric="trajectories/sec, 20q noisy circuit as one batched vmap "
+               "ensemble at state-vector cost")
+
+
 #: the committed full-detail artifact, written next to this file
 DETAIL_FILE = "BENCH_DETAIL.json"
 
@@ -1318,7 +1462,7 @@ def main() -> None:
                    choices=["all", "statevec", "density", "density_f64",
                             "f64", "plan_f64", "plan_34q_f64",
                             "20q", "24q", "26q", "serve", "resilience",
-                            "sentinel", "comm"],
+                            "sentinel", "comm", "trajectories"],
                    default="all",
                    help="all: every BASELINE.json milestone config (default);"
                         " statevec: one random Clifford+T run at --qubits;"
@@ -1344,7 +1488,11 @@ def main() -> None:
                         " rollback-and-replay bit-identity);"
                         " comm: the comm_20q row (pipelined collectives"
                         " A/B on a real multi-device mesh, bit-identity +"
-                        " depth-invariant comm model asserted)")
+                        " depth-invariant comm model asserted);"
+                        " trajectories: the trajectories_20q row (T noisy"
+                        " trajectories as one vmap ensemble at"
+                        " state-vector cost, ensemble-mean-vs-oracle +"
+                        " seed-replay bit-identity asserted)")
     p.add_argument("--emit", choices=["headline", "full"],
                    default="headline",
                    help="headline: compact <=1KB final line + "
@@ -1461,6 +1609,10 @@ def main() -> None:
         r = _comm_config(args.reps, args.smoke)
         _emit(r, [r], args.emit)
         return
+    if args.config == "trajectories":
+        r = _trajectories_config(args.reps, args.smoke)
+        _emit(r, [r], args.emit)
+        return
     if args.config in ("20q", "24q", "26q"):
         r = bench_statevec(int(args.config[:-1]), args.depth, args.reps,
                            sync)
@@ -1499,6 +1651,11 @@ def main() -> None:
             # 8-virtual-device mesh -- bit-identity at depth 4 and the
             # depth-invariant comm model == telemetry (ISSUE 10 gate)
             cfgs.append(_comm_config(3, True))
+            # ... and the trajectory row: T noisy trajectories as one
+            # vmap ensemble -- ensemble mean inside the 4/sqrt(T) band
+            # of the density oracle, fixed seeds replay bit-identically
+            # (incl. the 20q sharded-mesh leg via the 8-device subprocess)
+            cfgs.append(_trajectories_config(2, True))
         _emit(r, cfgs, args.emit)
         return
 
@@ -1543,6 +1700,7 @@ def main() -> None:
     configs.append(bench_resilience(20, 4, args.reps))
     configs.append(bench_sentinel(20, 4, args.reps))
     configs.append(_comm_config(args.reps, False))
+    configs.append(_trajectories_config(args.reps, False))
     # headline = the 26q statevec config, selected by metric string so list
     # reordering can never silently change what is reported
     headline = dict(next(c for c in configs

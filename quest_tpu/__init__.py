@@ -66,5 +66,10 @@ from .resilience import (  # noqa: F401
     QuESTHangError, QuESTIntegrityError, QuESTPreemptionError,
     QuESTRetryError, QuESTTimeoutError, resume_segmented,
 )
+from . import channels  # noqa: F401
+from . import trajectories  # noqa: F401
+from .trajectories import (  # noqa: F401
+    applyTrajectoryKraus, ensemble_density, run_ensemble, unravel,
+)
 
 __version__ = "0.1.0"

@@ -168,6 +168,18 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "the guarded call did not return within QUEST_WATCHDOG_MS; "
               "a typed QuESTHangError was raised instead of blocking "
               "forever -- check the mesh for a wedged device"),
+    # -- QT5xx: trajectory noise engine (docs/trajectories.md) --------------
+    "QT501": ("warning", "malformed QUEST_TRAJECTORIES value ignored",
+              "set QUEST_TRAJECTORIES to a positive integer ensemble "
+              "size; the default trajectory count was used instead "
+              "(statistical error scales as 1/sqrt(T))"),
+    "QT502": ("error", "non-CPTP Kraus set at a trajectory channel site",
+              "sum_k K_k^dagger K_k deviates from identity: the "
+              "trajectory sampler's selection probabilities would be "
+              "biased and the ensemble mean would NOT converge to the "
+              "channel; renormalise the operator set (non-TP maps have "
+              "no unraveling -- keep them on the density route via "
+              "mixNonTP*)"),
 }
 
 

@@ -1,4 +1,4 @@
-"""Tape linter: circuit-level advice and apply-time traps (QT0xx).
+"""Tape linter: circuit-level advice and apply-time traps (QT0xx, QT502).
 
 Walks a recorded ``Circuit`` tape through the fuser's own spy-capture
 (:func:`..fusion.capture`), so what is linted is exactly what the planner
@@ -23,6 +23,10 @@ resolved. Four lints:
   validators only see this at apply time; the linter sees it at record
   time. Also exposed standalone as :func:`lint_events` for synthetic /
   kernel-level event streams.
+
+A fifth check rides the same walk: **QT502** flags trajectory channel
+sites (``applyTrajectoryKraus`` entries, quest_tpu/trajectories) whose
+Kraus set is not CPTP -- a biased unraveling, caught at record time.
 
 Entries the spy cannot capture (operator entries, Param-carrying
 entries, inits) act as lint barriers, exactly as they act as fusion
@@ -119,6 +123,38 @@ def _freeze(v):
     return v
 
 
+#: completeness tolerance of the QT502 check, scaled by the operator
+#: dimension (mirrors validation.validate_kraus_ops at f64 working eps)
+_CPTP_ATOL = 1e-6
+
+
+def _lint_traj_kraus(args, kwargs, where: str) -> list[Finding]:
+    """QT502: a trajectory channel site whose Kraus set is not CPTP.
+    The sampler draws k with p_k = <psi|K_k^dagger K_k|psi>; unless
+    sum_k K_k^dagger K_k = I those probabilities are biased and the
+    ensemble mean converges to the WRONG channel -- flagged at record
+    time, before any trajectory runs."""
+    ops = kwargs.get("ops", args[1] if len(args) > 1 else None)
+    if ops is None:
+        return []
+    try:
+        k = [np.asarray(op, dtype=np.complex128) for op in ops]
+        dim = k[0].shape[0]
+        acc = np.zeros((dim, dim), dtype=np.complex128)
+        for op in k:
+            acc += op.conj().T @ op
+        dev = float(np.max(np.abs(acc - np.eye(dim))))
+    except Exception:
+        return []
+    if dev > _CPTP_ATOL * dim:
+        return [make_finding(
+            "QT502",
+            f"sum_k K_k^dagger K_k deviates from identity by {dev:.3g} "
+            f"({len(k)} ops, dim {dim}): trajectory selection "
+            f"probabilities are biased", where)]
+    return []
+
+
 def lint_tape(tape, num_qubits: int, *, is_density: bool = False,
               dtype=None, location: str = "tape") -> list[Finding]:
     """Lint a recorded tape (list of ``(fn, args, kwargs)`` entries); see
@@ -139,6 +175,8 @@ def lint_tape(tape, num_qubits: int, *, is_density: bool = False,
     for idx, (fn, args, kwargs) in enumerate(tape):
         name = getattr(fn, "__name__", "")
         where = f"{location}[{idx}]:{name}"
+        if name == "applyTrajectoryKraus":
+            findings.extend(_lint_traj_kraus(args, kwargs, where))
         events = capture(fn, args, kwargs, num_qubits, dt,
                          is_density=is_density)
         if events is None:

@@ -32,7 +32,8 @@ from .registers import Qureg
 
 #: API names that can be recorded on a tape: mutate qureg.amps, need no host
 #: round-trip at run time. (measure/collapse and calc* are excluded.)
-_TAPEABLE_MODULES = ("gates", "operators", "decoherence", "state_init")
+_TAPEABLE_MODULES = ("gates", "operators", "decoherence", "state_init",
+                     "trajectories.noise")
 _EXCLUDED = {
     "measure", "measureWithStats", "collapseToOutcome",
     # these need host data or aren't pure amps->amps

@@ -4,6 +4,13 @@
 Every channel is either a broadcasted diagonal factor (dephasing) or one dense
 superoperator application on qubits (T, T+N) -- see ops/density.py for why
 this single mechanism replaces the reference's bespoke MPI protocols.
+
+The built-in channels' Kraus operators live in ONE canonical table,
+``quest_tpu/channels.py`` (the ops.density builders delegate to it
+bit-identically), shared with the trajectory route: ``trajectories.unravel``
+rewrites every CPTP mix* site recorded on a density tape into a stochastic
+pure-state Kraus selection (docs/trajectories.md). The NonTP variants and
+``mixDensityMatrix`` have no trajectory unraveling and stay density-only.
 """
 
 from __future__ import annotations

@@ -96,7 +96,7 @@ from ..resilience.errors import (PoisonedRequestFault, QuESTBackpressureError,
                                  QuESTCancelledError, QuESTHangError,
                                  QuESTIntegrityError, QuESTTimeoutError)
 from . import cache as _cache
-from .params import bind
+from .params import _SEED, bind
 
 __all__ = ["Engine", "HEALTH_STATES"]
 
@@ -218,10 +218,16 @@ class Engine:
         self._thread = threading.Thread(target=self._loop,
                                         name="quest-engine", daemon=True)
         self._thread.start()
+        # seed-kind slots mark a trajectory-noise structure: each vmap lane
+        # of a batch then carries an independent PRNG stream
+        # (quest_tpu/trajectories), surfaced here for the flight recorder
+        self.seed_slots = sum(1 for s in self._lifted.slots
+                              if s.kind == _SEED)
         telemetry.event("engine.start", fingerprint=self.fingerprint[:12],
                         nsv=nsv, max_batch=self.max_batch,
                         sharded=self.sharded,
-                        params=len(self._lifted.param_names))
+                        params=len(self._lifted.param_names),
+                        seed_slots=self.seed_slots)
 
     # -- submission ---------------------------------------------------------
 

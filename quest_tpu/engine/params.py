@@ -29,6 +29,13 @@ Two tapes that differ only in lifted values produce the SAME
 :func:`quest_tpu.engine.cache.structure_fingerprint`, which is what lets the
 executable cache serve "same ansatz, different angles" traffic with zero
 recompiles (docs/serving.md).
+
+Besides the ``'real'``/``'complex'`` angle slots there is a third kind,
+``'seed'``: an integer PRNG-seed slot (uint32 on device) carried by
+trajectory-noise entries (quest_tpu/trajectories). Seed positions lift
+*plain ints* too -- a seed is always a runtime value, never structure -- so
+T trajectories of one noisy circuit share a single compiled replay and
+differ only in their stacked seed lanes (docs/trajectories.md).
 """
 
 from __future__ import annotations
@@ -84,8 +91,12 @@ P = Param
 #: else a tape entry carries (targets, controls, unitary matrices, channel
 #: probabilities -- whose superoperators are assembled host-side) is
 #: structure and stays baked.
-_REAL, _CPLX = "real", "complex"
+_REAL, _CPLX, _SEED = "real", "complex", "seed"
 _LIFTABLE = {
+    # trajectory noise: the per-trajectory PRNG seed is a runtime uint32
+    # slot -- T trajectories replay one compiled program with T seed
+    # streams stacked by the engine's vmap batcher (quest_tpu/trajectories)
+    "applyTrajectoryKraus": {2: _SEED, "seed": _SEED},
     "phaseShift": {1: _REAL, "angle": _REAL},
     "controlledPhaseShift": {2: _REAL, "angle": _REAL},
     "multiControlledPhaseShift": {1: _REAL, "angle": _REAL},
@@ -118,6 +129,17 @@ def is_value(x) -> bool:
     return isinstance(x, (float, complex, np.floating, np.complexfloating))
 
 
+def _is_seed_value(x) -> bool:
+    """Lifting rule for ``'seed'``-kind positions: unlike angle positions
+    (where ints are structure), a plain integer at a seed position IS the
+    runtime value -- it lifts to an anonymous uint32 slot so plan structure
+    never depends on the seed."""
+    if isinstance(x, Param):
+        return True
+    return (isinstance(x, (int, np.integer))
+            and not isinstance(x, bool))
+
+
 def has_params(args, kwargs=None) -> bool:
     """True when a tape entry's arguments carry a :class:`Param` anywhere
     (one level into tuples/lists) -- the fusion planner's pre-check: such
@@ -139,7 +161,7 @@ class Slot:
     caller rebinds the whole vector); named slots come from :class:`Param`
     placeholders and MUST be bound at execution."""
     index: int
-    kind: str                      # 'real' | 'complex'
+    kind: str                      # 'real' | 'complex' | 'seed'
     name: Optional[str] = None
     default: Optional[complex] = None
 
@@ -192,12 +214,19 @@ def lift_tape(tape) -> LiftedTape:
             slots.append(Slot(len(slots), kind, default=v))
         return _SlotRef(len(slots) - 1)
 
+    def liftable(v, kind):
+        if kind is None:
+            return False
+        if kind == _SEED:
+            return _is_seed_value(v)
+        return is_value(v)
+
     for fn, args, kwargs in tape:
         spec = _LIFTABLE.get(getattr(fn, "__name__", ""), {})
         new_args = []
         for i, v in enumerate(args):
             kind = spec.get(i)
-            if kind is not None and is_value(v):
+            if liftable(v, kind):
                 new_args.append(lift_value(v, kind))
             elif isinstance(v, Param) or (
                     isinstance(v, (tuple, list))
@@ -212,7 +241,7 @@ def lift_tape(tape) -> LiftedTape:
         new_kwargs = {}
         for k, v in kwargs.items():
             kind = spec.get(k)
-            if kind is not None and is_value(v):
+            if liftable(v, kind):
                 new_kwargs[k] = lift_value(v, kind)
             elif isinstance(v, Param):
                 raise QuESTError(
@@ -268,7 +297,14 @@ def bind(lifted: LiftedTape, params=None, device: bool = True) -> tuple:
             v = params[s.name]
         else:
             v = s.default
-        if device:
+        if s.kind == _SEED:
+            # seeds are integer PRNG material: uint32 on device (a stable
+            # jit signature the vmap batcher can stack per lane), a plain
+            # int on the host/constant path. int() first so the engine's
+            # warmup binding (0.0 for every name) coerces cleanly.
+            out.append(jnp.asarray(int(v), dtype=jnp.uint32) if device
+                       else int(v))
+        elif device:
             out.append(jnp.asarray(v, dtype=cdt if s.kind == _CPLX else rdt))
         else:
             out.append(complex(v) if s.kind == _CPLX else float(v))

@@ -250,6 +250,12 @@ def capture(fn, args, kwargs, num_qubits: int, dtype,
     never by the fuser (aux events carry no operator data)."""
     from .parallel import scheduler as _dist
 
+    # trajectory-noise sites (and anything else tagged _fusion_barrier)
+    # assemble their operator at apply time from runtime PRNG draws: there
+    # is no static event to capture, even with a constant seed
+    if getattr(fn, "_fusion_barrier", False):
+        return None
+
     aux_ctx = _aux_capture_ctx if aux else _null_ctx
     events: list = []
     shell = _SpyQureg(num_qubits, False, dtype)

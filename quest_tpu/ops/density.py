@@ -247,44 +247,30 @@ def apply_two_qubit_dephasing(amps, prob, *, n: int, q1: int, q2: int):
 
 
 def depolarising_kraus(prob: float):
-    """(1-p) rho + p/3 (X r X + Y r Y + Z r Z) (mixDepolarising, QuEST.h:4051)."""
-    from ..datatypes import PAULI_MATRICES
-    return [
-        np.sqrt(1 - prob) * PAULI_MATRICES[0],
-        np.sqrt(prob / 3) * PAULI_MATRICES[1],
-        np.sqrt(prob / 3) * PAULI_MATRICES[2],
-        np.sqrt(prob / 3) * PAULI_MATRICES[3],
-    ]
+    """(1-p) rho + p/3 (X r X + Y r Y + Z r Z) (mixDepolarising, QuEST.h:4051).
+    Operators come from the canonical channel table (quest_tpu.channels),
+    shared with the trajectory sampler."""
+    from ..channels import depolarising_kraus as _k
+    return _k(prob)
 
 
 def two_qubit_depolarising_superop(prob: float) -> np.ndarray:
     """rho -> (1-p) rho + p/15 sum_{(A,B) != (I,I)} (A x B) rho (A x B)
-    (mixTwoQubitDepolarising, QuEST.h:4156)."""
-    from ..datatypes import PAULI_MATRICES
-    ops = []
-    for a in range(4):
-        for b in range(4):
-            m = np.kron(PAULI_MATRICES[b], PAULI_MATRICES[a])  # qubit1 low bit
-            if a == 0 and b == 0:
-                ops.append(np.sqrt(1 - prob) * m)
-            else:
-                ops.append(np.sqrt(prob / 15) * m)
-    return kraus_superoperator(ops)
+    (mixTwoQubitDepolarising, QuEST.h:4156). Built from the canonical
+    16-operator Kraus list (quest_tpu.channels.two_qubit_depolarising_kraus)."""
+    from ..channels import two_qubit_depolarising_kraus as _k
+    return kraus_superoperator(_k(prob))
 
 
 def damping_kraus(prob: float):
-    """Amplitude damping (mixDamping, QuEST.h:4089)."""
-    k0 = np.array([[1, 0], [0, np.sqrt(1 - prob)]], dtype=np.complex128)
-    k1 = np.array([[0, np.sqrt(prob)], [0, 0]], dtype=np.complex128)
-    return [k0, k1]
+    """Amplitude damping (mixDamping, QuEST.h:4089); canonical operators
+    from quest_tpu.channels."""
+    from ..channels import damping_kraus as _k
+    return _k(prob)
 
 
 def pauli_kraus(px: float, py: float, pz: float):
-    """mixPauli as a 4-operator Kraus map (QuEST_common.c:740-760)."""
-    from ..datatypes import PAULI_MATRICES
-    return [
-        np.sqrt(1 - px - py - pz) * PAULI_MATRICES[0],
-        np.sqrt(px) * PAULI_MATRICES[1],
-        np.sqrt(py) * PAULI_MATRICES[2],
-        np.sqrt(pz) * PAULI_MATRICES[3],
-    ]
+    """mixPauli as a 4-operator Kraus map (QuEST_common.c:740-760); canonical
+    operators from quest_tpu.channels."""
+    from ..channels import pauli_kraus as _k
+    return _k(px, py, pz)

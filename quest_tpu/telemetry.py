@@ -9,9 +9,11 @@ of them report into and every artifact is derived from:
 
 - **Counters / gauges / histograms**, labeled Prometheus-style
   (``inc("engine_fallback_total", reason="df_tile_mismatch")``) -- the
-  fusion planner, the distributed scheduler, the exchange kernels and the
-  Pallas dispatch layer all record here (see the instrumentation map in
-  docs/observability.md).
+  fusion planner, the distributed scheduler, the exchange kernels, the
+  Pallas dispatch layer and the trajectory noise engine (the
+  ``trajectory_*`` series: channel sites unraveled per kind, trajectories
+  run, ensembles driven -- docs/trajectories.md) all record here (see the
+  instrumentation map in docs/observability.md).
 - **Nested host-side spans** with monotonic timing
   (``with span("fusion.plan", qubits=26): ...``): each completed span
   aggregates into the registry (count / total_s / max_s) and, optionally,

@@ -1,0 +1,222 @@
+"""Unravel a noisy circuit and run its trajectory ensemble through the Engine.
+
+``unravel`` rewrites a density-matrix tape (gates + mix* channels) into a
+state-vector tape whose channel sites are :func:`noise.applyTrajectoryKraus`
+entries sharing ONE named seed Param; ``run_ensemble`` then executes T
+trajectories as T parameter bindings of that single structure through
+:class:`~quest_tpu.engine.Engine` -- the engine's vmap-over-params batcher
+stacks the seed lanes, so the whole ensemble is one fixed-shape compiled
+program (cuQuantum-style batched ensemble apply, arXiv:2308.01999), riding
+the plan/executable cache and the sharded route unchanged.
+
+Cost: a trajectory is a state vector, so a T-trajectory ensemble at n
+qubits costs T * 2^n amplitudes against the density route's 4^n -- at 20q
+with T=256 that is 64x fewer amplitudes than one density register, and it
+opens sizes (20q+) where no density matrix fits at all. The price is
+statistical: observables converge at 1/sqrt(T) (docs/trajectories.md has
+the when-to-prefer table).
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import channels as _channels
+from .. import telemetry
+from ..circuits import Circuit
+from ..engine.params import _SEED, Param
+from ..validation import QuESTError
+from . import noise
+
+__all__ = ["unravel", "run_ensemble", "ensemble_density",
+           "trajectory_count_default", "TrajectoryResult",
+           "DEFAULT_TRAJECTORIES", "SEED_PARAM"]
+
+#: ensemble size when neither an argument nor QUEST_TRAJECTORIES says
+#: otherwise -- 64 keeps the 1/sqrt(T) error near 0.125 at interactive cost.
+DEFAULT_TRAJECTORIES = 64
+
+#: the Param name `unravel` records its seed slot under.
+SEED_PARAM = "traj_seed"
+
+#: general Kraus mix* entries that unravel directly (their operator lists
+#: are already explicit on the tape).
+_KRAUS_MIX = {"mixKrausMap", "mixTwoQubitKrausMap", "mixMultiQubitKrausMap"}
+
+#: entries no unraveling exists for: non-trace-preserving maps have no
+#: probability interpretation (the sampler's p_k would be biased -- the
+#: same hazard tapelint flags as QT502), and mixDensityMatrix needs a
+#: second register.
+_UNRAVELABLE = {"mixNonTPKrausMap", "mixNonTPTwoQubitKrausMap",
+                "mixNonTPMultiQubitKrausMap", "mixDensityMatrix"}
+
+_ENV_WARNED: set = set()
+
+
+def trajectory_count_default() -> int:
+    """Ensemble size from ``QUEST_TRAJECTORIES`` (malformed or sub-1 values
+    warn once as QT501 and fall back to ``DEFAULT_TRAJECTORIES``)."""
+    from ..analysis.diagnostics import parse_env_int
+    return parse_env_int("QUEST_TRAJECTORIES", DEFAULT_TRAJECTORIES,
+                         minimum=1, code="QT501", warned=_ENV_WARNED,
+                         noun="trajectory count")
+
+
+def _bound_args(fn, args, kwargs):
+    """The entry's arguments by parameter name (qureg bound to None)."""
+    sig = inspect.signature(fn)
+    ba = sig.bind(None, *args, **kwargs)
+    ba.apply_defaults()
+    return ba.arguments
+
+
+def _channel_site(name, fn, args, kwargs):
+    """(table_key, targets, kraus_ops) of one recorded channel entry."""
+    got = _bound_args(fn, args, kwargs)
+    if name in _channels.MIX_CHANNELS:
+        key = _channels.MIX_CHANNELS[name]
+        spec = _channels.CHANNELS[key]
+        if spec.num_targets == 1:
+            targets = (int(got["target"]),)
+        else:
+            targets = (int(got["q1"]), int(got["q2"]))
+        if key == "pauli":
+            probs = (float(got["px"]), float(got["py"]), float(got["pz"]))
+        else:
+            probs = (float(got["prob"]),)
+        return key, targets, tuple(_channels.kraus_ops(key, *probs))
+    # explicit Kraus entries
+    if name == "mixKrausMap":
+        targets = (int(got["target"]),)
+    elif name == "mixTwoQubitKrausMap":
+        targets = (int(got["q1"]), int(got["q2"]))
+    else:
+        targets = tuple(int(t) for t in got["targets"])
+    ops = tuple(np.asarray(op, dtype=np.complex128) for op in got["ops"])
+    return "kraus", targets, ops
+
+
+def unravel(circuit: Circuit, seed=None) -> Circuit:
+    """Rewrite a noisy (typically density-matrix) circuit into its
+    trajectory form: every built-in mix* channel and explicit CPTP Kraus
+    entry becomes an :func:`noise.applyTrajectoryKraus` site over a pure
+    state; every other entry passes through unchanged (the gate functions
+    branch on the register kind themselves).
+
+    All sites share one seed value slot (``seed``, default
+    ``P("traj_seed")``) and carry consecutive static ``site`` indices, so
+    one uint32 per trajectory drives an independent counter-based stream at
+    every site. Non-trace-preserving maps (mixNonTP*) and
+    ``mixDensityMatrix`` have no unraveling and raise."""
+    if seed is None:
+        seed = Param(SEED_PARAM)
+    out = Circuit(circuit.num_qubits, is_density_matrix=False)
+    site = 0
+    for fn, args, kwargs in circuit._tape:
+        name = getattr(fn, "__name__", "")
+        if name in _UNRAVELABLE:
+            raise QuESTError(
+                f"cannot unravel '{name}': non-trace-preserving maps have "
+                "no trajectory probability interpretation (QT502)" if
+                name != "mixDensityMatrix" else
+                "cannot unravel 'mixDensityMatrix': it mixes in a second "
+                "register, not a Kraus channel")
+        if name in _channels.MIX_CHANNELS or name in _KRAUS_MIX:
+            key, targets, ops = _channel_site(name, fn, args, kwargs)
+            out.append(noise.applyTrajectoryKraus, targets, ops, seed,
+                       site=site)
+            telemetry.inc("trajectory_channels_total", channel=key)
+            site += 1
+        else:
+            out.append(fn, *args, **kwargs)
+    return out
+
+
+def ensemble_density(states) -> np.ndarray:
+    """The ensemble-mean density matrix (2^n, 2^n complex) of a stack of
+    planar trajectory states (T, 2, 2^n) -- the small-n oracle-comparison
+    helper; rho[i, j] = mean_t psi_t[i] conj(psi_t[j])."""
+    arr = np.asarray(states, dtype=np.float64)
+    psi = arr[:, 0, :] + 1j * arr[:, 1, :]
+    return psi.T @ psi.conj() / psi.shape[0]
+
+
+@dataclass(frozen=True)
+class TrajectoryResult:
+    """One executed ensemble: ``states`` is the (T, 2, 2^n) planar stack in
+    seed order, ``seeds`` the per-trajectory uint32 seeds, ``seed_name``
+    the bound Param. ``density()`` gives the ensemble-mean density matrix
+    (small n only: it materialises 4^n complex entries)."""
+    states: np.ndarray
+    seeds: tuple
+    seed_name: str
+
+    @property
+    def num_trajectories(self) -> int:
+        return len(self.seeds)
+
+    def density(self) -> np.ndarray:
+        return ensemble_density(self.states)
+
+
+def run_ensemble(circuit: Circuit, num_trajectories: int | None = None, *,
+                 env=None, seeds=None, base_seed: int = 0, params=None,
+                 max_batch: int | None = None,
+                 precision_code: int | None = None, initial="zero",
+                 timeout: float | None = None) -> TrajectoryResult:
+    """Execute a trajectory ensemble of ``circuit`` through the serving
+    engine: one Engine per call, T = ``num_trajectories`` (default: the
+    QUEST_TRAJECTORIES count) seed bindings submitted atomically so the
+    vmap batcher coalesces them into ceil(T / max_batch) fixed-shape
+    dispatches of ONE compiled program.
+
+    ``circuit`` may be the density form (it is unraveled here) or an
+    already-unraveled tape carrying exactly one named seed Param. ``seeds``
+    overrides the default ``base_seed + t`` stream ids; ``params`` supplies
+    any additional named Params the tape carries. Replaying with the same
+    seeds is bit-identical -- sharded or not, f32 or f64/df."""
+    from ..engine import Engine
+
+    if circuit.is_density_matrix:
+        circuit = unravel(circuit)
+    lifted = circuit.lifted()
+    seed_names = sorted({s.name for s in lifted.slots
+                         if s.kind == _SEED and s.name is not None})
+    if len(seed_names) != 1:
+        raise QuESTError(
+            f"run_ensemble needs exactly one named seed Param on the tape, "
+            f"found {seed_names or 'none'}; record channels via unravel() "
+            f"(its sites share P({SEED_PARAM!r}))")
+    seed_name = seed_names[0]
+    if seeds is None:
+        t_count = (int(num_trajectories) if num_trajectories is not None
+                   else trajectory_count_default())
+        if t_count < 1:
+            raise QuESTError(
+                f"num_trajectories must be >= 1, got {t_count}")
+        seeds = [int(base_seed) + t for t in range(t_count)]
+    else:
+        seeds = [int(s) for s in seeds]
+        if not seeds:
+            raise QuESTError("seeds must be non-empty")
+    sites = sum(1 for fn, _, _ in circuit._tape
+                if getattr(fn, "__name__", "") == "applyTrajectoryKraus")
+    mb = min(len(seeds), max_batch) if max_batch else len(seeds)
+    eng = Engine(circuit, env, max_batch=mb, max_delay_ms=0.0,
+                 precision_code=precision_code, initial=initial)
+    try:
+        reqs = [dict(params or {}, **{seed_name: s}) for s in seeds]
+        futs = eng.submit_many(reqs, timeout=timeout)
+        states = np.stack([np.asarray(f.result()) for f in futs])
+    finally:
+        eng.close()
+    telemetry.inc("trajectory_runs_total", len(seeds))
+    telemetry.inc("trajectory_sites_total", sites * len(seeds))
+    telemetry.inc("trajectory_ensembles_total")
+    telemetry.event("trajectories.ensemble", trajectories=len(seeds),
+                    sites=sites, max_batch=mb, sharded=eng.sharded)
+    return TrajectoryResult(states=states, seeds=tuple(seeds),
+                            seed_name=seed_name)

@@ -1,0 +1,121 @@
+"""Branch-free stochastic Kraus selection over a pure state.
+
+The quantum-trajectory unraveling (qsim's approximate-noise technique,
+arXiv:2111.02396): at a channel site with Kraus operators {K_k}, a
+trajectory draws index k with probability p_k = <psi| K_k^dagger K_k |psi>
+and continues in the renormalised state K_k|psi> / sqrt(p_k). The ensemble
+mean of |psi><psi| over trajectories converges to the density-matrix
+evolution at 1/sqrt(T).
+
+Everything here must be *traceable with a value-independent structure*: the
+selection runs inside the engine's one compiled vmap-over-params program, so
+there is no branching on the drawn index. Instead:
+
+- the selection probabilities come from ONE reduced-density-matrix pass over
+  the target qubits (p_k = Tr(M_k rho_red) with M_k = K_k^dagger K_k baked
+  host-side), not from applying each operator;
+- the drawn index is the branch-free inverse-CDF count
+  ``sum(u * norm >= cumsum(p))``;
+- the selected operator is assembled by a one-hot contraction over the baked
+  Kraus stack, with the 1/sqrt(p_k) renormalisation folded into the matrix
+  itself -- one ordinary (non-unitary) ``ops.apply.apply_matrix`` pass
+  applies it, riding the same sharded/grouped-transpose machinery as every
+  gate.
+
+The PRNG is counter-based (threefry): ``fold_in(PRNGKey(seed), site)``
+gives every channel site its own stream from one per-trajectory uint32
+seed, deterministic across shardings, devices and replays -- the
+bit-identical-replay contract of docs/trajectories.md.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import apply as _apply
+
+__all__ = ["kraus_probabilities", "traj_kraus_matrix", "apply_traj_kraus"]
+
+#: probability floor for the folded renormalisation: a trajectory can only
+#: reach a p_k this small through numerical cancellation (the CPTP check
+#: bounds real channels away from it), so the clamp never biases sampling.
+_P_FLOOR = 1e-30
+
+
+def _targets_front(plane, n, targets):
+    """One planar component (2^n,) reshaped/permuted to (d, rest) with the
+    collapsed target index s = sum_j bit(targets[j]) << j -- targets[0] is
+    the least-significant matrix bit, the apply_matrix convention."""
+    t = len(targets)
+    x = plane.reshape((2,) * n)
+    # row-major reshape puts qubit q at axis (n-1-q)
+    axes = [n - 1 - q for q in reversed(targets)]
+    rest = [a for a in range(n) if a not in axes]
+    x = jnp.transpose(x, axes + rest)
+    return x.reshape(2 ** t, -1)
+
+
+def kraus_probabilities(amps, mre, mim, *, n, targets):
+    """p_k = Tr(M_k rho_red) for the whole Kraus stack in one reduction
+    pass: ``amps`` is the planar (2, 2^n) state, ``mre``/``mim`` the baked
+    real/imag parts of M_k = K_k^dagger K_k, shape (m, d, d). Returns the
+    (m,) probability vector in the state's real dtype (sums to the current
+    squared norm for a CPTP set)."""
+    a = _targets_front(amps[0], n, targets)
+    b = _targets_front(amps[1], n, targets)
+    # rho_red[s,t] = R[s,t] + i I[s,t] over the d-dim target subspace
+    r = a @ a.T + b @ b.T
+    im = b @ a.T - a @ b.T
+    mre = jnp.asarray(mre, dtype=amps.dtype)
+    mim = jnp.asarray(mim, dtype=amps.dtype)
+    # Re Tr(M rho) = sum_{s,t} Mre[t,s] R[s,t] - Mim[t,s] I[s,t]
+    p = jnp.einsum("kts,st->k", mre, r) - jnp.einsum("kts,st->k", mim, im)
+    return jnp.maximum(p, 0.0)
+
+
+def traj_kraus_matrix(p, u, kre, kim, dtype):
+    """The selected-and-renormalised Kraus operator as a planar (2, d, d)
+    matrix, branch-free: ``p`` the (m,) probability vector, ``u`` a uniform
+    [0,1) draw, ``kre``/``kim`` the baked (m, d, d) Kraus stack. Selection
+    is norm-proportional (``u`` scaled by sum(p), so slight norm drift
+    cannot push the draw off the table) and the 1/sqrt(p_k) renormalisation
+    is folded into the returned matrix."""
+    m = p.shape[0]
+    cdf = jnp.cumsum(p)
+    draw = u.astype(p.dtype) * cdf[-1]
+    idx = jnp.minimum(jnp.sum((draw >= cdf).astype(jnp.int32)), m - 1)
+    w = (jnp.arange(m) == idx).astype(dtype)
+    p_sel = jnp.sum(w * p.astype(dtype))
+    scale = jax.lax.rsqrt(jnp.maximum(p_sel, jnp.asarray(_P_FLOOR, dtype)))
+    kre = jnp.asarray(kre, dtype=dtype)
+    kim = jnp.asarray(kim, dtype=dtype)
+    sel_re = jnp.einsum("k,kij->ij", w, kre) * scale
+    sel_im = jnp.einsum("k,kij->ij", w, kim) * scale
+    return jnp.stack([sel_re, sel_im])
+
+
+def apply_traj_kraus(amps, kraus, *, n, targets, seed, site):
+    """One trajectory step: sample a Kraus operator of ``kraus`` (a host
+    list/stack of complex operators) on ``targets`` and apply it
+    renormalised. ``seed`` is the per-trajectory uint32 (python int or
+    traced device scalar -- the lifted seed slot); ``site`` is the static
+    per-site counter that decorrelates channel sites within a trajectory.
+
+    Structure (shapes, plan, branch layout) is independent of both the seed
+    value and the drawn index -- the invariant that lets T trajectories
+    share one compiled vmap program."""
+    k = np.asarray([np.asarray(op, dtype=np.complex128) for op in kraus])
+    m_ops = np.einsum("kli,klj->kij", k.conj(), k)  # K^dagger K, baked
+    p = kraus_probabilities(amps, m_ops.real, m_ops.imag,
+                            n=n, targets=tuple(targets))
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), site)
+    # float32 draw regardless of route: f32/f64/df trajectories of one seed
+    # walk the same Kraus path
+    u = jax.random.uniform(key, dtype=jnp.float32)
+    km = traj_kraus_matrix(p, u, k.real, k.imag, amps.dtype)
+    from ..parallel import scheduler as _dist
+    sched = _dist.active()
+    apply_fn = sched.apply_matrix if sched else _apply.apply_matrix
+    return apply_fn(amps, km, n=n, targets=tuple(targets))

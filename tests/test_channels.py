@@ -1,0 +1,125 @@
+"""Canonical channel table (quest_tpu/channels.py).
+
+The satellite contract of the extraction: moving the built-in channels'
+Kraus operators out of the decoherence/density bodies into one shared
+table must leave the density route BIT-IDENTICAL. The literal operator
+expressions below are the pre-extraction bodies copied verbatim; the
+table (and the ops/density delegating builders) must reproduce them
+exactly -- np.array_equal, not allclose. On top of that: every table
+entry is CPTP at every in-range probability, and the new dephasing Kraus
+forms (which only the trajectory route consumes) reproduce the density
+route's broadcast diagonals when pushed through the superoperator.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import channels as CH
+from quest_tpu.datatypes import PAULI_MATRICES
+from quest_tpu.ops import density as DN
+
+PROBS = (0.0, 0.1, 0.37, 0.5)
+
+
+def _literal_depolarising(prob):
+    return [np.sqrt(1 - prob) * PAULI_MATRICES[0],
+            np.sqrt(prob / 3) * PAULI_MATRICES[1],
+            np.sqrt(prob / 3) * PAULI_MATRICES[2],
+            np.sqrt(prob / 3) * PAULI_MATRICES[3]]
+
+
+def _literal_damping(prob):
+    return [np.array([[1, 0], [0, np.sqrt(1 - prob)]], dtype=np.complex128),
+            np.array([[0, np.sqrt(prob)], [0, 0]], dtype=np.complex128)]
+
+
+def _literal_pauli(px, py, pz):
+    return [np.sqrt(1 - px - py - pz) * PAULI_MATRICES[0],
+            np.sqrt(px) * PAULI_MATRICES[1],
+            np.sqrt(py) * PAULI_MATRICES[2],
+            np.sqrt(pz) * PAULI_MATRICES[3]]
+
+
+def _literal_two_qubit_depolarising_superop(prob):
+    ops = []
+    for a in range(4):
+        for b in range(4):
+            m = np.kron(PAULI_MATRICES[b], PAULI_MATRICES[a])
+            if a == 0 and b == 0:
+                ops.append(np.sqrt(1 - prob) * m)
+            else:
+                ops.append(np.sqrt(prob / 15) * m)
+    return DN.kraus_superoperator(ops)
+
+
+@pytest.mark.parametrize("prob", PROBS)
+def test_density_builders_bit_identical_to_pre_extraction(prob):
+    for got, want in zip(DN.depolarising_kraus(prob),
+                         _literal_depolarising(prob)):
+        assert np.array_equal(got, want)
+    for got, want in zip(DN.damping_kraus(prob), _literal_damping(prob)):
+        assert np.array_equal(got, want)
+    for got, want in zip(DN.pauli_kraus(0.1, prob / 2, 0.2),
+                         _literal_pauli(0.1, prob / 2, 0.2)):
+        assert np.array_equal(got, want)
+    assert np.array_equal(DN.two_qubit_depolarising_superop(prob),
+                          _literal_two_qubit_depolarising_superop(prob))
+
+
+@pytest.mark.parametrize("name", sorted(CH.CHANNELS))
+@pytest.mark.parametrize("prob", (0.05, 0.3))
+def test_table_entries_are_cptp(name, prob):
+    spec = CH.CHANNELS[name]
+    probs = (0.1,) * spec.num_probs if spec.num_probs > 1 else (prob,)
+    ops = CH.kraus_ops(name, *probs)
+    dim = 2 ** spec.num_targets
+    assert all(op.shape == (dim, dim) for op in ops)
+    acc = sum(op.conj().T @ op for op in ops)
+    np.testing.assert_allclose(acc, np.eye(dim), atol=1e-12)
+
+
+@pytest.mark.parametrize("prob", (0.1, 0.33, 0.5))
+def test_dephasing_kraus_matches_density_diagonal(prob):
+    """The trajectory-route dephasing Kraus sets push through the
+    superoperator to EXACTLY the density route's broadcast diagonals."""
+    s1 = DN.kraus_superoperator(CH.dephasing_kraus(prob))
+    np.testing.assert_allclose(np.diag(DN.dephase_factors_1q(prob)), s1,
+                               atol=1e-15)
+    s2 = DN.kraus_superoperator(CH.two_qubit_dephasing_kraus(prob))
+    np.testing.assert_allclose(np.diag(DN.dephase_factors_2q(prob)), s2,
+                               atol=1e-15)
+
+
+def test_mix_channel_map_covers_builtins():
+    assert set(CH.MIX_CHANNELS.values()) == set(CH.CHANNELS)
+    for api_name in CH.MIX_CHANNELS:
+        assert hasattr(qt, api_name)
+    with pytest.raises(ValueError, match="probability"):
+        CH.kraus_ops("pauli", 0.1)          # wrong arity
+    with pytest.raises(KeyError):
+        CH.kraus_ops("nonesuch", 0.1)
+
+
+def test_density_route_unchanged_end_to_end():
+    """A density circuit exercising every built-in channel produces the
+    same state as applying the table-built superoperators by hand."""
+    import jax
+
+    n = 3
+    env = qt.createQuESTEnv(jax.devices()[:1])
+    dm = qt.createDensityQureg(n, env)
+    qt.initPlusState(dm)
+    qt.mixDepolarising(dm, 0, 0.3)
+    qt.mixDamping(dm, 1, 0.2)
+    qt.mixPauli(dm, 2, 0.1, 0.05, 0.15)
+
+    ref = qt.createDensityQureg(n, env)
+    qt.initPlusState(ref)
+    for targets, ops in (
+            ((0,), CH.kraus_ops("depolarising", 0.3)),
+            ((1,), CH.kraus_ops("damping", 0.2)),
+            ((2,), CH.kraus_ops("pauli", 0.1, 0.05, 0.15))):
+        s = DN.kraus_superoperator(ops)
+        ref.put(DN.apply_channel(ref.amps, s, n=n, targets=targets))
+    assert np.array_equal(np.asarray(dm.amps), np.asarray(ref.amps))
