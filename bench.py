@@ -391,8 +391,12 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
     print(f"# {n}q: fused {num_gates} gates -> {len(fused)} blocks",
           file=sys.stderr)
     if len(fused) > 48:
-        fn = fused.compiled_blocks(max_gates=24, donate=True)
+        # round 13: frame-identity segment programs instead of raw
+        # 24-entry blocks -- same compile-boundedness, but every seam is
+        # checkpointable and the dispatch count is the SEGMENT count
+        fn = fused.compiled_segments(max_items=24, donate=True)
         inner = 1
+        dispatches_per_circuit = float(fn.num_segments)
     elif inner > 1:
         # chain INNER applications inside one program (the loop-inside-jit
         # methodology of tools/microbench.py) so the timed region measures
@@ -408,8 +412,10 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
 
         fn = jax.jit(chained, donate_argnums=(0,))
         num_gates *= inner
+        dispatches_per_circuit = 1.0 / inner
     else:
         fn = fused.compiled(donate=True)
+        dispatches_per_circuit = 1.0
 
     t0 = time.perf_counter()
     # the configured precision, NOT hardcoded f32: under QUEST_PRECISION=2
@@ -460,6 +466,11 @@ def bench_statevec(n: int, depth: int, reps: int, sync) -> dict:
         "vs_baseline": round(gates_per_sec / ref, 3) if ref else None,
         "detail": {
             "chained_circuits": inner, "blocks_per_circuit": len(fused),
+            # device dispatches ONE circuit application costs on this
+            # operating point (round 13: <1 when several applications
+            # chain inside one program, num_segments on the segment-
+            # chain path for deep tapes)
+            "dispatches_per_circuit": round(dispatches_per_circuit, 4),
             # the DMA ring operating point this run executed with
             # (sweepable via QUEST_PALLAS_RING / Circuit.fused(ring_depth))
             "ring_depth": _ring_depth(),
@@ -1346,6 +1357,117 @@ def _comm_config(reps: int, smoke: bool) -> dict:
                "the explicit scheduler (monolithic vs depth-4)")
 
 
+def bench_dispatch(n: int, depth: int, reps: int) -> dict:
+    """CI-gate config ``dispatch_20q`` (round 13, ISSUE 12): the
+    whole-segment single-dispatch A/B. Runs the SAME fused circuit
+    item-by-item (the pre-round-13 interpreter: the host walks the tape
+    and every entry is its own device dispatch) and as frame-identity
+    segment programs (``Circuit.compiled_segments``: ONE dispatch per
+    segment), both from the same |+...+> init. Telemetry deltas prove
+    the dispatch collapse exactly -- the item leg counts one
+    ``device_dispatch_total{route="item"}`` per tape entry, the segment
+    leg one ``route="segment"`` per segment -- and the headline is the
+    amortization factor items/segments. Both routes are asserted
+    run-to-run DETERMINISTIC (bit-identical), and the two legs must
+    agree within the dtype band; exact bit-identity ACROSS program
+    granularities is an XLA-CPU non-goal (cross-program fma
+    recontraction -- the documented tests/test_sharded_df.py caveat; on
+    TPU the Mosaic kernel is opaque to XLA and the routes coincide)."""
+    import time
+
+    import jax
+
+    import quest_tpu as qt
+    from quest_tpu import segments, telemetry
+    from quest_tpu.precision import real_dtype
+
+    metric = (f"single-dispatch segment programs A/B, {n}q fused "
+              f"Clifford+T (one dispatch per tape item vs per segment)")
+    env = qt.createQuESTEnv(jax.devices()[:1])
+    fused = build_circuit(n, depth).fused(max_qubits=5, pallas=True)
+    items = len(fused)
+    if items < 2:
+        return {"config": "dispatch_20q", "metric": metric, "value": None,
+                "unit": "x fewer dispatches", "vs_baseline": None,
+                "note": f"{n}q fused to a single tape item; the A/B "
+                        "needs a multi-item plan"}
+
+    def item_state():
+        q = qt.createQureg(n, env)
+        qt.initPlusState(q)
+        with segments.force_route("item"):
+            segments.run_slice(fused, q)
+        return np.asarray(jax.device_get(q.amps))
+
+    chain = fused.compiled_segments()           # whole tape, coarsest cuts
+
+    def seg_state():
+        q = qt.createQureg(n, env)
+        qt.initPlusState(q)
+        q.put(chain(q.amps))
+        return np.asarray(jax.device_get(q.amps))
+
+    i0 = telemetry.counter_value("device_dispatch_total", route="item")
+    a1 = item_state()
+    item_dispatches = int(telemetry.counter_value(
+        "device_dispatch_total", route="item") - i0)
+    s0 = telemetry.counter_value("device_dispatch_total", route="segment")
+    b1 = seg_state()
+    seg_dispatches = int(telemetry.counter_value(
+        "device_dispatch_total", route="segment") - s0)
+    bit_identical = (np.array_equal(a1, item_state())
+                     and np.array_equal(b1, seg_state()))
+    route_maxdiff = float(np.max(np.abs(a1 - b1)))
+    tol = 1e-13 if np.dtype(real_dtype()) == np.dtype("float64") else 1e-5
+    del a1, b1
+
+    # timing: 1 warm (above) + best-of-k per leg; the item leg pays the
+    # host interpreter + one dispatch per entry, the segment leg one
+    # dispatch per segment -- the difference IS the dispatch tax
+    k = max(min(reps, 3), 1)
+    q = qt.createQureg(n, env)
+    qt.initPlusState(q)
+    best_item = float("inf")
+    for _ in range(k):
+        t0 = time.perf_counter()
+        with segments.force_route("item"):
+            segments.run_slice(fused, q)
+        q.amps.block_until_ready()
+        best_item = min(best_item, time.perf_counter() - t0)
+    amps = q.amps
+    best_seg = float("inf")
+    for _ in range(k):
+        t0 = time.perf_counter()
+        amps = chain(amps)
+        amps.block_until_ready()
+        best_seg = min(best_seg, time.perf_counter() - t0)
+    del amps, q
+
+    amort = items / chain.num_segments
+    return {
+        "config": "dispatch_20q",
+        "metric": metric,
+        "value": round(amort, 2),
+        "unit": "x fewer dispatches",
+        "vs_baseline": None,
+        "detail": {
+            "qubits": n,
+            "depth": depth,
+            "tape_items": items,
+            "num_segments": chain.num_segments,
+            "item_dispatches": item_dispatches,
+            "segment_dispatches": seg_dispatches,
+            "dispatch_amortization": round(amort, 2),
+            "bit_identical": bool(bit_identical),
+            "route_maxdiff": route_maxdiff,
+            "route_agreement_ok": bool(route_maxdiff <= tol),
+            "item_ms": round(best_item * 1e3, 2),
+            "segment_ms": round(best_seg * 1e3, 2),
+            "speedup": round(best_item / best_seg, 3),
+        },
+    }
+
+
 def _trajectories_config(reps: int, smoke: bool) -> dict:
     """Run the trajectories_20q row, re-execing into an 8-virtual-device
     subprocess when this process's backend has a single device, so the
@@ -1462,7 +1584,8 @@ def main() -> None:
                    choices=["all", "statevec", "density", "density_f64",
                             "f64", "plan_f64", "plan_34q_f64",
                             "20q", "24q", "26q", "serve", "resilience",
-                            "sentinel", "comm", "trajectories"],
+                            "sentinel", "comm", "trajectories",
+                            "dispatch"],
                    default="all",
                    help="all: every BASELINE.json milestone config (default);"
                         " statevec: one random Clifford+T run at --qubits;"
@@ -1492,7 +1615,12 @@ def main() -> None:
                         " trajectories: the trajectories_20q row (T noisy"
                         " trajectories as one vmap ensemble at"
                         " state-vector cost, ensemble-mean-vs-oracle +"
-                        " seed-replay bit-identity asserted)")
+                        " seed-replay bit-identity asserted);"
+                        " dispatch: the dispatch_20q row (whole-segment"
+                        " single-dispatch A/B: one device dispatch per"
+                        " tape item vs one per frame-identity segment,"
+                        " dispatch counts from telemetry + determinism"
+                        " asserted)")
     p.add_argument("--emit", choices=["headline", "full"],
                    default="headline",
                    help="headline: compact <=1KB final line + "
@@ -1613,6 +1741,10 @@ def main() -> None:
         r = _trajectories_config(args.reps, args.smoke)
         _emit(r, [r], args.emit)
         return
+    if args.config == "dispatch":
+        r = bench_dispatch(20, 2 if args.smoke else 4, args.reps)
+        _emit(r, [r], args.emit)
+        return
     if args.config in ("20q", "24q", "26q"):
         r = bench_statevec(int(args.config[:-1]), args.depth, args.reps,
                            sync)
@@ -1656,6 +1788,10 @@ def main() -> None:
             # of the density oracle, fixed seeds replay bit-identically
             # (incl. the 20q sharded-mesh leg via the 8-device subprocess)
             cfgs.append(_trajectories_config(2, True))
+            # ... and the dispatch row: whole-segment single-dispatch
+            # A/B -- one dispatch per tape item vs one per segment,
+            # telemetry-counted, routes deterministic (ISSUE 12 gate)
+            cfgs.append(bench_dispatch(20, 2, 3))
         _emit(r, cfgs, args.emit)
         return
 
@@ -1701,6 +1837,7 @@ def main() -> None:
     configs.append(bench_sentinel(20, 4, args.reps))
     configs.append(_comm_config(args.reps, False))
     configs.append(_trajectories_config(args.reps, False))
+    configs.append(bench_dispatch(20, 4, args.reps))
     # headline = the 26q statevec config, selected by metric string so list
     # reordering can never silently change what is reported
     headline = dict(next(c for c in configs

@@ -85,6 +85,12 @@ CATALOG: dict[str, tuple[str, str, str]] = {
                        "geometry",
               "k must be <= tile_bits - LANE_BITS, hi >= tile_bits and "
               "hi + k <= n for the kernel's bit-block swap"),
+    "QT107": ("error", "segment-program stamp diverges from the frame-"
+                       "identity segmentation",
+              "item.seg must equal the count of identity returns before "
+              "the item, in FusePlan order (quest_tpu.segments."
+              "stamp_plan); re-stamp via Circuit.fused or drop the "
+              "stamps (None skips the check per item)"),
     # -- QT2xx: kernel / DMA ring -------------------------------------------
     "QT201": ("error", "DMA ring load-slot hazard",
               "a ring slot's load must start, be waited, and be consumed "
@@ -142,6 +148,11 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "the generation was skipped and resume fell back to an "
               "older verified snapshot; investigate the named shard for "
               "torn writes or corruption"),
+    "QT306": ("warning", "QUEST_SEGMENT_DISPATCH is malformed or out of "
+                         "range",
+              "set QUEST_SEGMENT_DISPATCH to 0 (per-item interpretation) "
+              "or a positive integer (single-dispatch segment programs, "
+              "the default); the malformed value was replaced"),
     # -- QT4xx: integrity sentinels / self-healing (docs/resilience.md) -----
     "QT401": ("error", "total-probability drift beyond the precision "
                        "tolerance band",
@@ -245,8 +256,9 @@ def parse_env_int(env: str, default: int, *, minimum: int, code: str,
     per distinct raw value, tracked in the caller-owned ``warned`` set
     (so each knob warns per process, not per launch). The silent coercion
     stays -- the caller must still launch -- but it is no longer silent.
-    Shared by ``QUEST_PALLAS_RING`` (QT205) and ``QUEST_COMM_PIPELINE``
-    (QT206) instead of per-knob hand-rolled parsers."""
+    Shared by ``QUEST_PALLAS_RING`` (QT205), ``QUEST_COMM_PIPELINE``
+    (QT206) and ``QUEST_SEGMENT_DISPATCH`` (QT306) instead of per-knob
+    hand-rolled parsers."""
     raw = os.environ.get(env, "").strip()
     if not raw:
         return default

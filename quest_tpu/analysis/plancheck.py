@@ -15,6 +15,11 @@ over an explicit position permutation and proves
   (QT106, the static twin of ``_fused_local_run``'s runtime ValueError),
 - the composed permutation returns to identity before any non-Pallas
   item and at plan end (QT102),
+- every segment-program stamp (``item.seg``, round 13:
+  :func:`quest_tpu.segments.stamp_plan`) equals the independently
+  re-derived frame-identity segment index, in FusePlan order (QT107) --
+  so each emitted single-dispatch segment provably starts and ends at
+  frame identity; unstamped items (pre-round-13 tapes) skip the check,
 - each run's DMA-ring operating point is hazard-free and in budget
   (delegated to :mod:`.ringcheck`).
 
@@ -135,9 +140,26 @@ def check_plan(plan, nsv: int, *, dtype=None,
         perm = [swap_position(perm[p], tile_bits, k, hi)
                 for p in range(nsv)]
 
+    # QT107: re-derive the frame-identity segment index independently of
+    # the stamps (segments.stamp_plan's rule: the index advances at every
+    # return to identity) and cross-check each stamped item
+    seg_expect = 0
+
+    def check_seg(item, where: str) -> None:
+        if item.seg is None:
+            return  # pre-round-13 tape / unplanned item: no stamp
+        if item.seg != seg_expect:
+            findings.append(make_finding(
+                "QT107",
+                f"item stamped seg={item.seg} but the frame-identity "
+                f"replay puts it in segment {seg_expect}: the emitted "
+                f"segment program would not start/end at identity or "
+                f"the plan order was shuffled", where))
+
     for i, item in enumerate(plan.items):
         where = f"{location}.items[{i}]"
         if isinstance(item, PallasRun):
+            check_seg(item, where)
             apply_swap_event(item.tile_bits, item.load_swap_k,
                              item.load_swap_hi, where + ".load_swap")
             for j, op in enumerate(item.ops):
@@ -165,6 +187,7 @@ def check_plan(plan, nsv: int, *, dtype=None,
                         grid, depth, planes * s * _LANES * itemsize,
                         location=where + ".ring"))
         elif isinstance(item, FrameSwap):
+            check_seg(item, where)
             apply_swap_event(item.tile_bits, item.k, item.hi, where)
         elif isinstance(item, (FusedBlock, DiagBlock)) or \
                 isinstance(item, tuple):
@@ -175,6 +198,8 @@ def check_plan(plan, nsv: int, *, dtype=None,
                     f"non-Pallas item reached with a live frame "
                     f"(positions {moved[:8]} displaced)", where))
                 perm = list(identity)  # report once, keep checking
+        if perm == identity:
+            seg_expect += 1
     if perm != identity:
         moved = [p for p in range(nsv) if perm[p] != p]
         findings.append(make_finding(
@@ -294,6 +319,24 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
             else:
                 findings.append(make_finding(
                     "QT103", f"unknown permute kind {pkind!r}", where))
+        elif kind == "segment":
+            # round 13: zero-cost marker -- a sliced segment-program
+            # replay opened a defer span at tape cursor rec[1]. Segments
+            # cut at frame-identity points, so the tracked layout must be
+            # identity when a new span opens (QT104 otherwise: a prior
+            # span leaked an unreconciled layout across the segment seam)
+            _, cursor = rec
+            if not isinstance(cursor, int) or cursor < 0:
+                findings.append(make_finding(
+                    "QT107", f"segment marker cursor {cursor!r} is not a "
+                             f"tape index >= 0", where))
+            if pos != list(range(n)):
+                moved = [q for q in range(n) if pos[q] != q]
+                findings.append(make_finding(
+                    "QT104",
+                    f"segment span opens at cursor {cursor} with logical "
+                    f"qubits {moved[:8]} displaced: the previous span "
+                    f"did not reconcile", where))
         elif kind == "reconcile_done":
             if pos != list(range(n)):
                 moved = [q for q in range(n) if pos[q] != q]

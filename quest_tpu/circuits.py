@@ -268,7 +268,7 @@ class Circuit:
         entries defer."""
         return self._replay_fn(None)
 
-    def _replay_fn(self, lifted):
+    def _replay_fn(self, lifted, lo: int = 0, hi: int | None = None):
         """The replay body behind :meth:`as_fn` (``lifted=None``) and the
         parameterized executables (``lifted`` an engine.params.LiftedTape):
         with a lifted tape the returned ``fn(amps, values)`` substitutes the
@@ -276,10 +276,20 @@ class Circuit:
         each application, so gate matrices assemble from runtime values
         inside the one compiled program. Each trace of the parameterized
         form counts ``engine_trace_total{kind=param_replay}`` (the retrace
-        detector the serving tests assert on)."""
+        detector the serving tests assert on).
+
+        ``lo``/``hi`` restrict the replay to ``tape[lo:hi]`` -- the
+        segment programs of :mod:`quest_tpu.segments` (round 13). Slices
+        are whole replays in miniature: lookahead, deferred-permutation
+        scope, and reconciliation all cover exactly the slice, which is
+        sound because segment boundaries are frame-identity points.
+        Slicing composes with plain replay only (``lifted`` entries are
+        indexed against the whole tape)."""
         from .parallel import scheduler as _dist
 
-        tape = tuple(self._tape)
+        if lifted is not None and (lo != 0 or hi is not None):
+            raise ValueError("sliced replay requires lifted=None")
+        tape = tuple(self._tape[lo:hi])
         entries = tuple(lifted.entries) if lifted is not None else None
         num_qubits, is_density = self.num_qubits, self.is_density_matrix
         nsv = (2 if is_density else 1) * num_qubits
@@ -295,7 +305,12 @@ class Circuit:
                 steps = [materialize_entry(e, values) for e in entries]
             shell = Qureg(num_qubits, is_density, amps, env=None)
             sched = _dist.active()
-            started = sched.begin_defer() if sched is not None else False
+            # sliced replays label their defer span with the slice origin
+            # so a journaled segmented plan re-prices per segment
+            # (plancheck.check_schedule "segment" records)
+            seg_label = lo if (lo != 0 or hi is not None) else None
+            started = sched.begin_defer(segment=seg_label) \
+                if sched is not None else False
             try:
                 if started:
                     if not lookahead_cell:
@@ -526,6 +541,12 @@ class Circuit:
             for item in p.items:
                 if isinstance(item, (fusion.PallasRun, fusion.FrameSwap)):
                     item.comm_pipeline = int(comm_pipeline)
+        # round 13: stamp each frame-carrying item with its frame-identity
+        # segment index (the single-dispatch segment programs' seams;
+        # plancheck QT107 re-derives and cross-checks the stamps)
+        from . import segments as _segments
+        _segments.stamp_plan(
+            p, (2 if self.is_density_matrix else 1) * self.num_qubits)
         from . import analysis
         if analysis.verify_enabled():
             # QUEST_VERIFY=1: statically verify the plan's frame/ring
@@ -577,6 +598,7 @@ class Circuit:
 
             def chained(amps, _fns=tuple(fns)):
                 for f in _fns:
+                    telemetry.inc("device_dispatch_total", route="block")
                     amps = f(amps)
                 return amps
 
@@ -584,8 +606,29 @@ class Circuit:
 
         return _ec.executables().get_or_create(key, build)
 
+    def compiled_segments(self, max_items: int | None = None,
+                          donate: bool = True):
+        """The tape as a chain of frame-identity-aligned segment programs
+        (round 13, :mod:`quest_tpu.segments`): each segment is ONE jitted
+        dispatch covering up to ``max_items`` tape entries, cut only at
+        frame-identity seams. Supersedes :meth:`compiled_blocks` for deep
+        tapes -- same bounded per-program compile size, but the seams are
+        legal checkpoint/resume points and the dispatch tax is the
+        SEGMENT count, not the block count (``max_items=None`` = the
+        whole tape as one program). The chain exposes its link count as
+        ``.num_segments``; every link launch counts
+        ``device_dispatch_total{route="segment"}``."""
+        from . import segments
+        return segments.chain_executable(self, max_items=max_items,
+                                         donate=donate)
+
     def run(self, qureg: Qureg) -> Qureg:
-        """Apply the circuit to ``qureg`` (mutates its amps, like the C API)."""
+        """Apply the circuit to ``qureg`` (mutates its amps, like the C API).
+
+        The whole tape is one jitted program -- already the degenerate
+        single-dispatch segment -- counted as
+        ``device_dispatch_total{route="circuit"}`` (host-side: counters
+        inside the program would count traces, not launches)."""
         if qureg.num_qubits_represented != self.num_qubits or \
            qureg.is_density_matrix != self.is_density_matrix:
             raise ValueError(
@@ -593,6 +636,7 @@ class Circuit:
                 f"cannot run on {qureg!r}")
         from . import fusion
         with fusion.pallas_mesh(_register_mesh(qureg)):
+            telemetry.inc("device_dispatch_total", route="circuit")
             qureg.put(self.compiled()(qureg.amps))
         return qureg
 

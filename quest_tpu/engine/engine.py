@@ -592,6 +592,9 @@ class Engine:
         for req in batch:
             if req.poison is not None:
                 raise PoisonedRequestFault("engine.request", req.poison)
+            # one param-replay program launch per request (host-side
+            # count: inside the program it would count traces)
+            telemetry.inc("device_dispatch_total", route="engine_param")
             res = self._maybe_corrupt(
                 x.with_values(self.initial_amps + 0, req.values))
             self._sentinel_gate(res)
@@ -609,6 +612,7 @@ class Engine:
                 raise PoisonedRequestFault("engine.request", req.poison)
         if not self._lifted.slots:
             # value-free structure: every request computes the same state
+            telemetry.inc("device_dispatch_total", route="engine_param")
             out = self._maybe_corrupt(
                 self._exec1().with_values(self.initial_amps + 0, ()))
             self._sentinel_gate(out)
@@ -621,6 +625,8 @@ class Engine:
         stacked = tuple(jnp.stack([v[k] for v in vals])
                         for k in range(len(self._lifted.slots)))
         amps_b = jnp.repeat(self.initial_amps[None], self.max_batch, axis=0)
+        # the whole coalesced batch is ONE vmap program launch
+        telemetry.inc("device_dispatch_total", route="engine_vmap")
         out = self._execB()(amps_b, stacked)
         for i, req in enumerate(batch):
             lane = self._maybe_corrupt(out[i])

@@ -456,6 +456,11 @@ class PallasRun:
     #: QUEST_COMM_PIPELINE default; bit-identical at every depth --
     #: exchange.dist_permute_bits)
     comm_pipeline: int | None = None
+    #: frame-identity segment index this run belongs to (round 13:
+    #: quest_tpu.segments.stamp_plan; plancheck QT107 re-derives and
+    #: checks it). Plan-time annotation only -- ignored at apply time;
+    #: None on pre-round-13 tapes and unplanned items.
+    seg: int | None = None
 
 
 @dataclass
@@ -474,6 +479,8 @@ class FrameSwap:
     #: comm-pipeline depth when the transpose rides the scheduler's
     #: grouped permute collective (None = default; see PallasRun)
     comm_pipeline: int | None = None
+    #: frame-identity segment index (see PallasRun.seg)
+    seg: int | None = None
 
 
 def _window(qubits) -> tuple:
@@ -1084,15 +1091,17 @@ def plan_from_tape(tape) -> FusePlan:
             ops, tb, lk, sk, lh, sh = a[:6]
             rd = a[6] if len(a) > 6 else None
             cp = a[7] if len(a) > 7 else None
+            sg = a[8] if len(a) > 8 else None
             p.items.append(PallasRun(tuple(ops), tb, load_swap_k=lk,
                                      store_swap_k=sk, load_swap_hi=lh,
                                      store_swap_hi=sh, ring_depth=rd,
-                                     comm_pipeline=cp))
+                                     comm_pipeline=cp, seg=sg))
         elif name == "_apply_frame_swap":
             tb, k, hi = a[:3]
             p.items.append(FrameSwap(tb, k, hi,
                                      comm_pipeline=(a[3] if len(a) > 3
-                                                    else None)))
+                                                    else None),
+                                     seg=(a[4] if len(a) > 4 else None)))
         elif name == "_apply_dense_block":
             p.items.append(FusedBlock(tuple(a[1]), a[0]))
         elif name == "_apply_gate_diag":
@@ -1292,7 +1301,8 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                       load_swap_hi: int | None = None,
                       store_swap_hi: int | None = None,
                       ring_depth: int | None = None,
-                      comm_pipeline: int | None = None) -> None:
+                      comm_pipeline: int | None = None,
+                      seg: int | None = None) -> None:
     """Tape-entry wrapper for a PallasRun. Ops are RAW kernel ops over the
     full flattened state: density plans carry explicit conj-shadow twins
     (fusion._shadow_pop), so no path here re-derives shadows.
@@ -1920,7 +1930,8 @@ def _apply_dense_block(qureg, U: np.ndarray, qubits: tuple) -> None:
 
 def _apply_frame_swap(qureg, tile_bits: int, k: int,
                       hi: int | None = None,
-                      comm_pipeline: int | None = None) -> None:
+                      comm_pipeline: int | None = None,
+                      seg: int | None = None) -> None:
     """Tape-entry wrapper for FrameSwap: one relabeling transpose. Works on
     every backend (plain XLA); on a sharded register GSPMD lowers it to the
     all-to-all the relabeling implies (shard-local when [hi, hi+k) avoids
@@ -1959,11 +1970,11 @@ def as_tape(p: FusePlan) -> list:
                             (item.ops, item.tile_bits, item.load_swap_k,
                              item.store_swap_k, item.load_swap_hi,
                              item.store_swap_hi, item.ring_depth,
-                             item.comm_pipeline), {}))
+                             item.comm_pipeline, item.seg), {}))
         elif isinstance(item, FrameSwap):
             entries.append((_apply_frame_swap,
                             (item.tile_bits, item.k, item.hi,
-                             item.comm_pipeline), {}))
+                             item.comm_pipeline, item.seg), {}))
         else:
             entries.append(item)
     return entries

@@ -141,6 +141,8 @@ class DistributedScheduler:
     #:   | ("virtual_swap", p1, p2) | ("reconcile_swap", n, a, b)
     #:   | ("permute", n, source, unit_scale, kind)
     #:   | ("reconcile_done", n)
+    #:   | ("segment", lo)   -- zero-cost marker: a sliced segment-program
+    #:     replay opened a defer span at tape cursor ``lo`` (round 13)
     #: plus one leading ("comm_pipeline", depth) stamp recording the
     #: resolved pipeline depth the plan's collectives launch at (priced at
     #: ZERO chunk-units by check_schedule: the proof that pipelining
@@ -206,12 +208,21 @@ class DistributedScheduler:
 
     # -- deferred-permutation machinery --------------------------------------
 
-    def begin_defer(self) -> bool:
+    def begin_defer(self, segment: int | None = None) -> bool:
         """Enter deferred mode; returns False if already deferring or
-        deferral is disabled (the caller then must not end it)."""
+        deferral is disabled (the caller then must not end it).
+
+        ``segment`` labels this defer span with its tape-slice origin
+        (round 13: sliced segment-program replays pass their ``lo``
+        cursor) -- journaled as a zero-cost ``("segment", lo)`` marker so
+        check_schedule re-prices a segmented plan per span. None (whole-
+        tape replays, plan_circuit) records nothing, keeping pre-round-13
+        journals byte-identical."""
         if self.deferring or not self.allow_defer:
             return False
         self.deferring = True
+        if segment is not None:
+            self._note("segment", int(segment))
         return True
 
     def end_defer(self, amps, n: int):

@@ -92,14 +92,10 @@ def _qt305_crc(gen_dir: str, e) -> None:
         "resilience.segmented")])
 
 
-def _swap_blocks(perm: list, tile_bits: int, k: int, hi) -> None:
-    """Apply one bit-block swap (the swap_bit_blocks relabeling) to the
-    symbolic frame: exchange blocks [tile_bits-k, tile_bits) and
-    [hi or tile_bits, +k)."""
-    lo1 = tile_bits - k
-    lo2 = tile_bits if hi is None else hi
-    for j in range(k):
-        perm[lo1 + j], perm[lo2 + j] = perm[lo2 + j], perm[lo1 + j]
+# the symbolic frame replay lives in quest_tpu.segments since round 13
+# (the segment-dispatch emitter shares the boundary computation); the
+# re-export keeps this module's historical surface
+from ..segments import _swap_blocks  # noqa: F401  (compat re-export)
 
 
 def segment_plan(tape, nsv: int, every_n_items: int = 1) -> list:
@@ -107,27 +103,17 @@ def segment_plan(tape, nsv: int, every_n_items: int = 1) -> list:
     indices starting at 0 and ending at ``len(tape)``, each a
     frame-identity boundary, spaced at least ``every_n_items`` tape
     entries apart (the next identity boundary when the exact spacing
-    lands mid-permutation)."""
+    lands mid-permutation). Boundaries come from
+    :func:`quest_tpu.segments.identity_boundaries` -- the same seams the
+    round-13 segment programs dispatch over, so a checkpoint cadence and
+    a segment-program chain always agree on where the frame is identity.
+    (The pre-round-13 replay here unpacked FrameSwap args as an exact
+    3-tuple and broke on comm_pipeline-stamped tapes; the shared
+    decoder's slice unpack is codec-tolerant.)"""
+    from ..segments import identity_boundaries
     if every_n_items < 1:
         raise _qt304(f"every_n_items must be >= 1, got {every_n_items}")
-    perm = list(range(nsv))
-    ident = list(range(nsv))
-    boundaries = [0]
-    for i, (f, a, _kw) in enumerate(tape):
-        name = getattr(f, "__name__", "")
-        if name == "_apply_pallas_run":
-            _ops, tb, lk, sk, lh, sh = a[:6]
-            if lk:
-                _swap_blocks(perm, tb, lk, lh)
-            if sk:
-                _swap_blocks(perm, tb, sk, sh)
-        elif name == "_apply_frame_swap":
-            tb, k, hi = a
-            _swap_blocks(perm, tb, k, hi)
-        # every other entry operates in (and preserves) the identity frame
-        # -- the invariant plancheck QT102 enforces on fused plans
-        if perm == ident:
-            boundaries.append(i + 1)
+    boundaries = identity_boundaries(tape, nsv)
     if boundaries[-1] != len(tape):
         raise _qt304(
             "tape does not return to the identity frame at its end "
@@ -192,12 +178,16 @@ def _checkpoint(circuit, qureg, checkpoint_dir: str, cursor: int,
 
 
 def _run_segment(circuit, qureg, lo: int, hi: int) -> None:
-    from ..circuits import Circuit
+    # round 13: the segment rides quest_tpu.segments.run_slice -- ONE
+    # segment-program dispatch, cached on the PARENT circuit's stable
+    # token (the pre-round-13 path built a throwaway Circuit per segment
+    # whose fresh cache token forced a full recompile of every segment
+    # on every run AND every healing replay). QUEST_SEGMENT_DISPATCH=0
+    # falls back to the per-item interpreter inside run_slice.
+    from .. import segments
 
-    seg = Circuit(circuit.num_qubits, circuit.is_density_matrix)
-    seg._tape = list(circuit._tape[lo:hi])
     with telemetry.span("segmented.segment", lo=lo, hi=hi):
-        seg.run(qureg)
+        segments.run_slice(circuit, qureg, lo, hi)
     telemetry.inc("segmented_segments_total")
     if faultinject.enabled():
         # the SDC injection point: one visit of state.corrupt per segment
@@ -276,6 +266,7 @@ def _heal(circuit, qureg, lo: int, hi: int, checkpoint_dir: str,
         with fusion.pallas_mesh(_register_mesh(qureg)):
             with faultinject.fault_plan("pallas.dispatch:compile:1+"):
                 for f, a, kw in circuit._tape[lo:hi]:
+                    telemetry.inc("device_dispatch_total", route="item")
                     f(qureg, *a, **kw)
         _recheck("degraded replay")
         return True

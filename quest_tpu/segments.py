@@ -1,0 +1,322 @@
+"""Whole-segment single-dispatch execution (ISSUE 12, round 13).
+
+Historically every PallasRun / FrameSwap / collective on a tape was its
+own device dispatch with the host interpreting the tape between them --
+BASELINE.md's round-5 methodology measured that fixed host dispatch+sync
+cost at ~25-100 ms per round (``dispatch_fixed_ms``), dominating serve
+latency at small sizes. This module lowers a whole FusePlan *segment* --
+a maximal tape slice whose two-frame permutation starts AND ends at
+identity (the same boundaries ``run_segmented`` checkpoints at, proved
+by plancheck QT102) -- into ONE jitted program dispatched once: the
+command-buffer/graph-launch idea from the cuQuantum lineage (PAPERS.md)
+re-targeted at XLA's one-traced-program-per-structure executable model.
+
+Three execution surfaces ride it:
+
+- :func:`run_slice` -- execute ``tape[lo:hi]`` on a register as one
+  segment program (or item-by-item when segment dispatch is off);
+  ``resilience.segmented`` uses it between checkpoints, with a stable
+  cache key so resumed/healed segments never retrace.
+- :func:`chain_executable` (behind ``Circuit.compiled_segments``) -- the
+  tape as a chain of frame-identity-aligned segment programs, each at
+  most ``max_items`` tape entries: the compile-boundedness of
+  ``compiled_blocks`` with checkpointable seams and a dispatch count
+  equal to the SEGMENT count, not the gate count.
+- the per-item interpreter (:func:`run_slice` with the knob off) -- the
+  fallback lattice rung: one device dispatch per tape entry, the
+  pre-round-13 behavior, kept verbatim for triage and degraded modes.
+
+Numeric contract (tests/test_segments.py pins all of it): a fixed
+segmentation is run-to-run deterministic (bit-identical) on every leg;
+the whole-tape segment program is bit-identical to ``compiled()``; and
+on a single device the native-dtype per-item chain
+(``compiled_segments(max_items=1)``) reproduces item-by-item
+interpretation bit-for-bit. ACROSS program granularities XLA-CPU
+duplicates producer expressions and contracts fma differently per
+compiled program (the documented tests/test_sharded_df.py caveat), so
+item-route vs multi-item-program comparisons -- and anything on the df
+route or a CPU mesh, where even single items embed differently -- agree
+to ~1 ulp, not bit-exactly. On TPU the Mosaic kernel is opaque to XLA,
+so recontraction cannot reach inside it and the routes coincide.
+
+Every device program launch counts ``device_dispatch_total{route}``
+host-side (telemetry counters inside jit would count traces, not
+executions): ``route="segment"`` per segment program, ``route="item"``
+per eagerly interpreted tape entry, ``route="circuit"`` per whole-tape
+``Circuit.run`` dispatch, ``route="engine_vmap"`` / ``"engine_param"``
+at the serving engine's two dispatch sites. docs/observability.md has
+the full table; ``bench.py --config dispatch`` measures the A/B.
+
+``QUEST_SEGMENT_DISPATCH`` (default 1 = on; 0 restores item-by-item
+interpretation) gates the lowering, parsed warn-once via
+``analysis.diagnostics.parse_env_int`` (QT306). :func:`force_route`
+overrides it per-thread for A/B harnesses.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from . import telemetry
+
+__all__ = [
+    "identity_boundaries", "segment_cuts", "stamp_plan",
+    "segment_dispatch_default", "segment_dispatch_enabled", "force_route",
+    "slice_executable", "run_slice", "chain_executable",
+]
+
+_SEG_ENV = "QUEST_SEGMENT_DISPATCH"
+_DEF_SEGMENT_DISPATCH = 1
+#: raw env strings already warned about (diagnostics.parse_env_int
+#: warn-once contract; tests monkeypatch a fresh set)
+_SEG_ENV_WARNED: set = set()
+
+_ROUTE = threading.local()
+
+
+def segment_dispatch_default() -> int:
+    """The ``QUEST_SEGMENT_DISPATCH`` env value (default 1 = segment
+    programs on, 0 = per-item interpretation), parsed warn-once: a
+    malformed or negative value emits QT306 and falls back to the
+    default."""
+    from .analysis.diagnostics import parse_env_int
+    return parse_env_int(_SEG_ENV, _DEF_SEGMENT_DISPATCH, minimum=0,
+                         code="QT306", warned=_SEG_ENV_WARNED,
+                         noun="segment-dispatch mode")
+
+
+def segment_dispatch_enabled() -> bool:
+    """Whether tape slices lower to single-dispatch segment programs:
+    a :func:`force_route` override if one is active on this thread,
+    else the ``QUEST_SEGMENT_DISPATCH`` env default."""
+    forced = getattr(_ROUTE, "route", None)
+    if forced is not None:
+        return forced == "segment"
+    return segment_dispatch_default() != 0
+
+
+@contextlib.contextmanager
+def force_route(route: str | None):
+    """Pin the execution route for this thread: ``"segment"`` (one
+    program per slice), ``"item"`` (per-entry interpretation), or None
+    (defer to the env knob). The A/B harnesses (bench dispatch_20q,
+    kernelprobe dispatch_sweep) use this to run both legs in one
+    process regardless of the ambient ``QUEST_SEGMENT_DISPATCH``."""
+    if route not in (None, "segment", "item"):
+        raise ValueError(f"unknown dispatch route {route!r}")
+    prev = getattr(_ROUTE, "route", None)
+    _ROUTE.route = route
+    try:
+        yield
+    finally:
+        _ROUTE.route = prev
+
+
+# -- frame-identity boundaries -----------------------------------------------
+
+def _swap_blocks(perm: list, tile_bits: int, k: int, hi) -> None:
+    """Apply one frame relabeling to the symbolic qubit permutation:
+    blocks ``[tile_bits-k, tile_bits)`` and ``[hi, hi+k)`` (``hi`` =
+    tile_bits when None) exchange, exactly mirroring what
+    ``swap_bit_blocks`` / the scheduler's frame transpose do to the
+    physical layout."""
+    lo = tile_bits - k
+    hi = tile_bits if hi is None else hi
+    for i in range(k):
+        perm[lo + i], perm[hi + i] = perm[hi + i], perm[lo + i]
+
+
+def identity_boundaries(tape, nsv: int) -> list:
+    """Indices ``i`` where the two-frame permutation is identity after
+    ``tape[:i]`` -- the legal segment seams. Always includes 0; includes
+    ``len(tape)`` iff the tape ends at identity (every fused plan does,
+    by the QT102 contract). Replays the frame symbolically from the
+    PallasRun load/store swaps and standalone FrameSwaps; all other
+    entries leave the frame untouched.
+
+    This is the ONE boundary computation -- ``resilience.segmented``
+    delegates here (its pre-round-13 replay unpacked FrameSwap args as
+    an exact 3-tuple and broke on the 4-arg comm_pipeline-stamped
+    entries of PR 8; the codec-tolerant slice unpack below is the
+    regression-tested fix)."""
+    perm = list(range(nsv))
+    ident = list(range(nsv))
+    bounds = [0]
+    for i, (f, a, _kw) in enumerate(tape):
+        name = getattr(f, "__name__", "")
+        if name == "_apply_pallas_run":
+            _ops, tb, lk, sk, lh, sh = a[:6]
+            if lk:
+                _swap_blocks(perm, tb, lk, lh)
+            if sk:
+                _swap_blocks(perm, tb, sk, sh)
+        elif name == "_apply_frame_swap":
+            tb, k, hi = a[:3]
+            _swap_blocks(perm, tb, k, hi)
+        if perm == ident:
+            bounds.append(i + 1)
+    return bounds
+
+
+def segment_cuts(tape, nsv: int, max_items: int | None = None) -> list:
+    """Greedy coarsest identity-aligned cut list ``[0, ..., len(tape)]``:
+    each segment is the LARGEST boundary-to-boundary span of at most
+    ``max_items`` tape entries (None = unbounded, typically the whole
+    tape as one program -- in the two-frame scheme most items restore
+    identity individually, so boundaries are plentiful and the cap, not
+    the boundary supply, sets the segment size). A single
+    boundary-to-boundary gap longer than ``max_items`` becomes its own
+    segment (frames cannot be cut mid-flight). A tape that does not end
+    at identity gets a final non-checkpointable segment to ``len(tape)``
+    -- execution stays correct; only fused plans guarantee the QT102
+    tail."""
+    if max_items is not None and max_items < 1:
+        raise ValueError("max_items must be >= 1")
+    bounds = identity_boundaries(tape, nsv)
+    if bounds[-1] != len(tape):
+        bounds.append(len(tape))
+    cuts = [0]
+    while cuts[-1] < len(tape):
+        start = cuts[-1]
+        nxt = [b for b in bounds if b > start]
+        if max_items is not None:
+            capped = [b for b in nxt if b - start <= max_items]
+            cuts.append(capped[-1] if capped else nxt[0])
+        else:
+            cuts.append(nxt[-1])
+    return cuts
+
+
+def stamp_plan(plan, nsv: int) -> int:
+    """Stamp every frame-carrying plan item (PallasRun / FrameSwap) with
+    the index of the frame-identity segment it belongs to (``item.seg``,
+    round-13 tape codec slot) and return the segment count. Segment
+    indices advance exactly at identity returns, so plancheck's QT107
+    check can re-derive them independently and prove each emitted
+    segment starts and ends at frame identity in FusePlan order."""
+    from . import fusion
+    perm = list(range(nsv))
+    ident = list(range(nsv))
+    seg = 0
+    for item in plan.items:
+        if isinstance(item, fusion.PallasRun):
+            item.seg = seg
+            if item.load_swap_k:
+                _swap_blocks(perm, item.tile_bits, item.load_swap_k,
+                             item.load_swap_hi)
+            if item.store_swap_k:
+                _swap_blocks(perm, item.tile_bits, item.store_swap_k,
+                             item.store_swap_hi)
+        elif isinstance(item, fusion.FrameSwap):
+            item.seg = seg
+            _swap_blocks(perm, item.tile_bits, item.k, item.hi)
+        if perm == ident:
+            seg += 1
+    return seg
+
+
+# -- segment programs --------------------------------------------------------
+
+def slice_executable(circuit, lo: int, hi: int, donate: bool = True):
+    """``tape[lo:hi]`` as ONE jitted executable -- the segment program.
+
+    Cached in the process-global bounded LRU (engine.cache.executables)
+    keyed on the circuit's stable ``_cache_token`` plus the slice and
+    execution-mode meshes, so repeated segment executions -- checkpoint
+    cadences, rollback-and-replay healing, bench chains -- dispatch
+    warm without retracing (the pre-round-13 ``run_segmented`` built a
+    fresh Circuit per segment and paid a full recompile every run).
+    Mesh pinning mirrors ``Circuit.compiled``: jit traces on first
+    call, which may happen under a different scheduler/pallas-mesh
+    context than the one this executable is keyed on."""
+    import jax
+
+    from . import fusion
+    from .engine import cache as _ec
+    from .parallel import scheduler as _dist
+    sched = _dist.active()
+    mesh = sched.mesh if sched else None
+    pmesh = fusion.active_pallas_mesh()
+    key = ("segment", circuit._cache_token, lo, hi, donate, mesh, pmesh)
+
+    def build():
+        inner = jax.jit(circuit._replay_fn(None, lo=lo, hi=hi),
+                        donate_argnums=(0,) if donate else ())
+
+        def fn(amps, _inner=inner, _mesh=mesh, _pmesh=pmesh):
+            from .circuits import _amps_mesh
+            pm = _pmesh if _pmesh is not None else _amps_mesh(amps)
+            with _dist.explicit_mesh(_mesh), fusion.pallas_mesh(pm):
+                return _inner(amps)
+
+        return fn
+
+    return _ec.executables().get_or_create(key, build)
+
+
+def run_slice(circuit, qureg, lo: int = 0, hi: int | None = None, *,
+              donate: bool = True):
+    """Execute ``tape[lo:hi]`` on ``qureg`` (mutates its amps).
+
+    With segment dispatch on (:func:`segment_dispatch_enabled`), the
+    slice runs as ONE segment program --
+    ``device_dispatch_total{route="segment"}`` counts exactly one
+    launch. Otherwise the host interprets item-by-item, the fallback
+    lattice rung: each entry is applied eagerly (its own device
+    program(s), the pre-round-13 behavior) and counts
+    ``route="item"``. Both routes satisfy the numeric contract in the
+    module docstring: deterministic per route, bit-identical where the
+    compiled programs match, ~1 ulp across program granularities on
+    XLA-CPU (granularity-invariant on TPU, where Mosaic kernels are
+    opaque to fma recontraction)."""
+    from . import fusion
+    from .circuits import _register_mesh
+    hi = len(circuit._tape) if hi is None else hi
+    if hi <= lo:
+        return qureg
+    with fusion.pallas_mesh(_register_mesh(qureg)):
+        if segment_dispatch_enabled():
+            fn = slice_executable(circuit, lo, hi, donate=donate)
+            telemetry.inc("device_dispatch_total", route="segment")
+            qureg.put(fn(qureg.amps))
+        else:
+            for f, a, kw in circuit._tape[lo:hi]:
+                telemetry.inc("device_dispatch_total", route="item")
+                f(qureg, *a, **kw)
+    return qureg
+
+
+def chain_executable(circuit, max_items: int | None = None,
+                     donate: bool = True):
+    """The whole tape as a chain of segment programs (one per
+    :func:`segment_cuts` span), behind ``Circuit.compiled_segments``.
+    Each link is a cached :func:`slice_executable`; the chain itself is
+    cached too. Calling the chain counts one
+    ``device_dispatch_total{route="segment"}`` per link -- the dispatch
+    tax is the segment count, amortizing the per-item tax by the mean
+    items-per-segment (the dispatch_20q bench row asserts the
+    collapse)."""
+    from . import fusion
+    from .engine import cache as _ec
+    from .parallel import scheduler as _dist
+    sched = _dist.active()
+    key = ("segment_chain", circuit._cache_token, max_items, donate,
+           sched.mesh if sched else None, fusion.active_pallas_mesh())
+
+    def build():
+        nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
+        cuts = segment_cuts(circuit._tape, nsv, max_items)
+        fns = tuple(slice_executable(circuit, a, b, donate=donate)
+                    for a, b in zip(cuts, cuts[1:]))
+
+        def chained(amps, _fns=fns):
+            for f in _fns:
+                telemetry.inc("device_dispatch_total", route="segment")
+                amps = f(amps)
+            return amps
+
+        chained.num_segments = len(fns)
+        return chained
+
+    return _ec.executables().get_or_create(key, build)

@@ -112,6 +112,50 @@ def comm_sweep(n):
           telemetry.snapshot("comm_collective_ms")["histograms"])
 
 
+def dispatch_sweep(n):
+    """Items-per-segment sweep (ISSUE 12 operating point): one fused
+    Clifford+T circuit executed as segment-program chains capped at
+    {1, 2, 4, 8, 16} items per program plus the uncapped whole-tape
+    program and the per-item interpreter rung, each timed end-to-end.
+    The fixed host dispatch+sync tax amortizes by the mean
+    items-per-segment, so the curve flattens once per-segment device
+    work dominates -- the committed BASELINE.md table regenerates from
+    this output alone (recipe there)."""
+    from bench import build_circuit
+
+    import quest_tpu as qt
+    from quest_tpu import segments
+
+    env = qt.createQuESTEnv(jax.devices()[:1])
+    fused = build_circuit(n, 4).fused(max_qubits=5, pallas=True)
+    items = len(fused._tape)
+    if items < 2:
+        print(f"# dispatch sweep skipped: {n}q fused to one item")
+        return
+    print(f"# dispatch sweep: {items} tape items")
+
+    def time_leg(apply_once, label, nseg):
+        q = qt.createQureg(n, env)
+        qt.initPlusState(q)
+        apply_once(q)                       # warm every program in the leg
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            apply_once(q)
+            q.amps.block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        print(f"dispatch {label:14s} segments={nseg:3d} "
+              f"{best * 1e3:8.3f} ms")
+
+    with segments.force_route("item"):
+        time_leg(lambda q: segments.run_slice(fused, q), "item-by-item",
+                 items)
+    for cap in (1, 2, 4, 8, 16, None):
+        fn = fused.compiled_segments(max_items=cap)
+        time_leg(lambda q, _f=fn: q.put(_f(q.amps)),
+                 f"cap={cap}", fn.num_segments)
+
+
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 26
     from quest_tpu.ops import pallas_gates as PG
@@ -162,6 +206,7 @@ def main():
 
     # --- comm-pipeline depth x collective-kind sweep (ISSUE 10) ---------
     comm_sweep(n)
+    dispatch_sweep(min(n, 20))
 
     # --- folded-swap DMA overheads (at the default S) -------------------
     # guard: a k-bit swap needs k grid bits above the tile (hi + k <= n)
