@@ -886,6 +886,119 @@ def bench_serving(n: int, depth: int, reps: int) -> dict:
     }
 
 
+def bench_pool(n: int, depth: int, reps: int) -> dict:
+    """CI-gate config ``pool_20q``: replica-pool serving (ISSUE 13) --
+    mixed-structure open-loop load over 3 replicas with ONE injected
+    replica kill mid-run. Measures sustained req/sec and p50/p99 request
+    latency under the failover, and asserts the robustness contract the
+    round-14 gate checks: ``lost_requests == 0`` (every future resolves),
+    ``failover_bitident`` (every served result -- failed-over ones
+    included -- is bit-identical to a lone-engine oracle; same
+    fingerprint -> same executable) and ``replacement_zero_retrace`` (the
+    replacement replica is warmed from the fingerprint manifest before
+    rotation, so its first real request performs zero retraces)."""
+    import time
+
+    import jax
+
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.engine import Engine, EnginePool
+    from quest_tpu.resilience import fault_plan
+
+    structures = [serving_ansatz(n, depth), serving_ansatz(n, depth + 1)]
+    rng = np.random.RandomState(13)
+
+    def draw(circ):
+        return {nm: float(v)
+                for nm, v in zip(circ.param_names,
+                                 rng.uniform(0, 2 * np.pi,
+                                             len(circ.param_names)))}
+
+    requests = 8 * max(min(reps, 4), 2)
+    work = [(c, draw(c))
+            for c in (structures[i % len(structures)]
+                      for i in range(requests))]
+
+    env = qt.createQuESTEnv(jax.devices()[:1])
+    # per-request oracle from lone engines (identical executable keys)
+    oracle = []
+    engs = {}
+    for c, p in work:
+        fp = c.fingerprint()
+        if fp not in engs:
+            engs[fp] = Engine(c, env, max_batch=8, max_delay_ms=0.0)
+        oracle.append(np.asarray(engs[fp].submit(p).result(600)))
+    for e in engs.values():
+        e.close()
+
+    f0 = telemetry.counter_value("pool_failovers_total", reason="kill")
+    r0 = telemetry.counter_value("pool_replacements_total", reason="kill")
+    pool = EnginePool(env, replicas=3, max_batch=8, max_delay_ms=1.0)
+    # absorb the per-structure cold compile outside the timed window (the
+    # executable LRU then shares it across every replica and the oracle)
+    for c in structures:
+        pool.submit(c, draw(c)).result(600)
+    lat: dict = {}
+    kill_at = requests // 2
+    with fault_plan(f"pool.replica:kill:{kill_at}"):
+        t0 = time.perf_counter()
+        futs = []
+        for i, (c, p) in enumerate(work):
+            ts = time.perf_counter()
+            f = pool.submit(c, p, tenant=f"tenant{i % 2}")
+            f.add_done_callback(
+                lambda fut, ts=ts, i=i:
+                lat.__setitem__(i, time.perf_counter() - ts))
+            futs.append(f)
+        results = [np.asarray(f.result(600)) for f in futs]
+        wall = time.perf_counter() - t0
+    lost = sum(1 for f in futs if not f.done())
+    bitident = all(np.array_equal(w, g) for w, g in zip(oracle, results))
+    failovers = telemetry.counter_value("pool_failovers_total",
+                                        reason="kill") - f0
+    # the replacement replica must re-enter rotation warm: first real
+    # request on it performs zero retraces (manifest warm + shared LRU)
+    pool.await_rotation(3, timeout=600)
+    replacements = telemetry.counter_value("pool_replacements_total",
+                                           reason="kill") - r0
+    new_rep = max(pool._replicas, key=lambda r: r.id)
+    tr0 = telemetry.counter_value("engine_trace_total", kind="param_replay")
+    c0, _ = work[0]
+    first = np.asarray(
+        new_rep.engines[c0.fingerprint()].submit(draw(c0)).result(600))
+    zero_retrace = telemetry.counter_value(
+        "engine_trace_total", kind="param_replay") == tr0
+    pool.close()
+    lats_ms = np.asarray(sorted(lat.values())) * 1e3
+    return {
+        "config": "pool_20q",
+        "metric": f"replica-pool serving, {requests} mixed-structure "
+                  f"{n}q requests over 3 replicas with one injected "
+                  "replica kill mid-run: sustained req/sec",
+        "value": round(requests / wall, 2),
+        "unit": "req/sec",
+        "vs_baseline": None,
+        "detail": {
+            "qubits": n,
+            "depth": depth,
+            "replicas": 3,
+            "structures": len(structures),
+            "requests": requests,
+            "req_per_sec": round(requests / wall, 2),
+            "p50_ms": round(float(np.percentile(lats_ms, 50)), 2),
+            "p99_ms": round(float(np.percentile(lats_ms, 99)), 2),
+            "wall_s": round(wall, 3),
+            "failovers": int(failovers),
+            "replacements": int(replacements),
+            "lost_requests": int(lost),
+            "failover_bitident": bool(bitident),
+            "replacement_zero_retrace": bool(zero_retrace),
+            "replacement_first_abs_sum": round(float(np.abs(first).sum()), 6),
+        },
+    }
+
+
 def trajectory_circuit(n: int):
     """The trajectories_20q noisy circuit: an entangled n-qubit base with
     one channel site from each built-in family (depolarising, damping,
@@ -1585,7 +1698,7 @@ def main() -> None:
                             "f64", "plan_f64", "plan_34q_f64",
                             "20q", "24q", "26q", "serve", "resilience",
                             "sentinel", "comm", "trajectories",
-                            "dispatch"],
+                            "dispatch", "pool"],
                    default="all",
                    help="all: every BASELINE.json milestone config (default);"
                         " statevec: one random Clifford+T run at --qubits;"
@@ -1620,6 +1733,12 @@ def main() -> None:
                         " single-dispatch A/B: one device dispatch per"
                         " tape item vs one per frame-identity segment,"
                         " dispatch counts from telemetry + determinism"
+                        " asserted);"
+                        " pool: the pool_20q row (replica-pool serving:"
+                        " mixed-structure open-loop load over 3 replicas,"
+                        " req/sec + p50/p99, one injected replica kill"
+                        " mid-run with zero lost futures + failover"
+                        " bit-identity + warmed-replacement zero-retrace"
                         " asserted)")
     p.add_argument("--emit", choices=["headline", "full"],
                    default="headline",
@@ -1745,6 +1864,10 @@ def main() -> None:
         r = bench_dispatch(20, 2 if args.smoke else 4, args.reps)
         _emit(r, [r], args.emit)
         return
+    if args.config == "pool":
+        r = bench_pool(20, 2 if args.smoke else 4, args.reps)
+        _emit(r, [r], args.emit)
+        return
     if args.config in ("20q", "24q", "26q"):
         r = bench_statevec(int(args.config[:-1]), args.depth, args.reps,
                            sync)
@@ -1792,6 +1915,11 @@ def main() -> None:
             # A/B -- one dispatch per tape item vs one per segment,
             # telemetry-counted, routes deterministic (ISSUE 12 gate)
             cfgs.append(bench_dispatch(20, 2, 3))
+            # ... and the pool row: replica-pool serving under one
+            # injected replica kill -- zero lost futures, failover
+            # bit-identity, warmed-replacement zero-retrace (ISSUE 13
+            # gate)
+            cfgs.append(bench_pool(20, 2, 3))
         _emit(r, cfgs, args.emit)
         return
 
@@ -1838,6 +1966,7 @@ def main() -> None:
     configs.append(_comm_config(args.reps, False))
     configs.append(_trajectories_config(args.reps, False))
     configs.append(bench_dispatch(20, 4, args.reps))
+    configs.append(bench_pool(20, 4, args.reps))
     # headline = the 26q statevec config, selected by metric string so list
     # reordering can never silently change what is reported
     headline = dict(next(c for c in configs

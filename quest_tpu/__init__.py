@@ -59,7 +59,7 @@ from .checkpoint import (  # noqa: F401
 from . import profiling  # noqa: F401
 from . import telemetry  # noqa: F401
 from . import engine  # noqa: F401
-from .engine import Engine, P, Param  # noqa: F401
+from .engine import Engine, EnginePool, P, Param  # noqa: F401
 from . import resilience  # noqa: F401
 from .resilience import (  # noqa: F401
     QuESTBackpressureError, QuESTCancelledError, QuESTChecksumError,

@@ -153,6 +153,12 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "set QUEST_SEGMENT_DISPATCH to 0 (per-item interpretation) "
               "or a positive integer (single-dispatch segment programs, "
               "the default); the malformed value was replaced"),
+    "QT307": ("warning", "malformed replica-pool/admission environment "
+                         "value replaced by its default",
+              "QUEST_POOL_REPLICAS must be an integer >= 1; "
+              "QUEST_HEDGE_MS and QUEST_TENANT_QPS must be integers >= 0 "
+              "(0 disables hedging / the quota); the malformed value was "
+              "replaced"),
     # -- QT4xx: integrity sentinels / self-healing (docs/resilience.md) -----
     "QT401": ("error", "total-probability drift beyond the precision "
                        "tolerance band",
@@ -257,8 +263,10 @@ def parse_env_int(env: str, default: int, *, minimum: int, code: str,
     (so each knob warns per process, not per launch). The silent coercion
     stays -- the caller must still launch -- but it is no longer silent.
     Shared by ``QUEST_PALLAS_RING`` (QT205), ``QUEST_COMM_PIPELINE``
-    (QT206) and ``QUEST_SEGMENT_DISPATCH`` (QT306) instead of per-knob
-    hand-rolled parsers."""
+    (QT206), ``QUEST_SEGMENT_DISPATCH`` (QT306) and the replica-pool
+    knobs ``QUEST_POOL_REPLICAS`` / ``QUEST_HEDGE_MS`` /
+    ``QUEST_TENANT_QPS`` (QT307) instead of per-knob hand-rolled
+    parsers."""
     raw = os.environ.get(env, "").strip()
     if not raw:
         return default

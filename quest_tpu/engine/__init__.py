@@ -14,6 +14,13 @@ needs to handle sweep/ensemble traffic (ROADMAP north star):
 - :mod:`.engine` -- :class:`Engine`: ``submit(params) -> Future`` with a
   micro-batcher coalescing requests into one ``vmap``-over-params program
   (unsharded) or a donated-buffer sequential replay (sharded).
+- :mod:`.pool` -- :class:`EnginePool`: N replicas behind health-aware,
+  structure-affine routing, with quarantine failover (zero dropped
+  futures, bit-identical recovery), hedged dispatch, and warm replacement
+  spawning from a fingerprint manifest.
+- :mod:`.admission` -- per-tenant token-bucket quotas with a
+  high-priority reserve band in front of the pool
+  (``QuESTBackpressureError`` with ``reason="quota"``).
 
 Quickstart::
 
@@ -34,6 +41,9 @@ See docs/serving.md for lifecycle, batching knobs and cache sizing.
 
 import os as _os
 
+from .admission import (  # noqa: F401
+    PRIORITIES, AdmissionController, TokenBucket,
+)
 from .cache import (  # noqa: F401
     LRUCache, enable_persistent_cache, executables, structure_fingerprint,
 )
@@ -41,11 +51,13 @@ from .engine import Engine  # noqa: F401
 from .params import (  # noqa: F401
     LiftedTape, P, Param, ParamExecutable, Slot, bind, lift_tape,
 )
+from .pool import EnginePool  # noqa: F401
 
 __all__ = [
     "Param", "P", "ParamExecutable", "LiftedTape", "Slot", "lift_tape",
     "bind", "LRUCache", "executables", "structure_fingerprint",
-    "enable_persistent_cache", "Engine",
+    "enable_persistent_cache", "Engine", "EnginePool",
+    "AdmissionController", "TokenBucket", "PRIORITIES",
 ]
 
 # opt-in cross-restart compile cache: wire it up as early as possible so

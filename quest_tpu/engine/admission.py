@@ -1,0 +1,178 @@
+"""Per-tenant admission control for the replica pool.
+
+The reference has no notion of tenancy: one caller owns the whole
+simulator. A pool serving many users (ROADMAP item 1) must decide, per
+request, whether to accept work BEFORE it consumes a replica's queue --
+otherwise one chatty tenant starves everyone behind the shared batchers.
+This module is that front door, layered on the existing backpressure
+vocabulary so callers need no new error handling:
+
+- :class:`TokenBucket` -- the classic rate limiter (``rate`` tokens/sec,
+  ``burst`` capacity, refill on read) with a twist that makes priority
+  non-starvation STRUCTURAL rather than probabilistic: the bottom
+  ``reserve_frac`` of the bucket is reserved for ``high``-priority
+  requests. A ``normal`` take must leave the reserve intact, so no volume
+  of normal traffic can drain the bucket below what the next high request
+  needs -- high requests are never starved by construction (the property
+  tests/test_pool.py proves by exhausting a bucket with normal traffic
+  and then admitting a high request).
+- :class:`AdmissionController` -- one bucket per tenant (created lazily
+  from a default QPS or an explicit per-tenant ``quotas`` map), the
+  ``admission_{admitted,rejected,queued}_total{tenant,priority}``
+  counters, and the typed rejection:
+  :class:`~quest_tpu.resilience.QuESTBackpressureError` with
+  ``reason="quota"`` (also counted under the engine's existing
+  ``engine_backpressure_total{reason=quota}`` series so fleet dashboards
+  aggregate one backpressure family).
+
+The default quota comes from ``QUEST_TENANT_QPS`` (integer requests/sec
+per tenant; 0 or unset = unlimited), parsed through
+:func:`~quest_tpu.analysis.diagnostics.parse_env_int` with the QT307
+warn-once diagnostic on malformed values. Time is injectable (``clock``)
+so quota tests run on a fake clock instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import telemetry
+from ..resilience.errors import QuESTBackpressureError
+
+__all__ = ["PRIORITIES", "TokenBucket", "AdmissionController"]
+
+#: admission priority classes, most urgent first
+PRIORITIES = ("high", "normal")
+
+#: QT307 warn-once tracking for QUEST_TENANT_QPS (one entry per distinct
+#: malformed raw value -- the knob warns per process, not per submit)
+_QPS_WARNED: set = set()
+
+
+def _env_tenant_qps() -> int:
+    from ..analysis.diagnostics import parse_env_int
+    return parse_env_int("QUEST_TENANT_QPS", 0, minimum=0, code="QT307",
+                         warned=_QPS_WARNED, noun="tenant QPS quota")
+
+
+class TokenBucket:
+    """Thread-safe token bucket with a high-priority reserve band.
+
+    ``rate`` tokens accrue per second up to ``burst`` capacity (default:
+    ``max(rate, 1)``). :meth:`take` refills from the injectable ``clock``
+    and then admits ``n`` tokens' worth of work: ``high`` priority needs
+    ``n`` tokens available; ``normal`` priority must ALSO leave
+    ``reserve_frac * burst`` tokens behind for future high requests.
+    The bucket starts full.
+    """
+
+    def __init__(self, rate: float, burst: float | None = None, *,
+                 reserve_frac: float = 0.25, clock=time.monotonic):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        if not 0.0 <= reserve_frac < 1.0:
+            raise ValueError(
+                f"reserve_frac must be in [0, 1), got {reserve_frac}")
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else max(rate, 1.0)
+        if self.burst < 1.0:
+            raise ValueError(f"burst must be >= 1, got {self.burst}")
+        #: tokens a ``normal`` take must leave behind (the high reserve)
+        self.reserve = reserve_frac * self.burst
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        if now > self._last:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def tokens(self) -> float:
+        """Current token count (refilled first; introspection/tests)."""
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
+
+    def take(self, n: int = 1, *, priority: str = "normal") -> bool:
+        """Admit ``n`` requests' worth of tokens, or return False without
+        taking anything (all-or-nothing, like Engine.submit_many)."""
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
+        with self._lock:
+            self._refill_locked()
+            floor = 0.0 if priority == "high" else self.reserve
+            if self._tokens - n < floor - 1e-9:
+                return False
+            self._tokens -= n
+            return True
+
+
+class AdmissionController:
+    """Per-tenant quota enforcement in front of an :class:`EnginePool`.
+
+    ``default_qps`` (None = read ``QUEST_TENANT_QPS``; 0 = unlimited)
+    seeds a lazily-created :class:`TokenBucket` per tenant; ``quotas``
+    maps specific tenants to their own QPS (0 disables the quota for
+    that tenant). :meth:`admit` either counts the admission or raises
+    the typed quota rejection -- it never blocks.
+    """
+
+    def __init__(self, default_qps: int | None = None, *,
+                 burst: float | None = None, quotas: dict | None = None,
+                 reserve_frac: float = 0.25, clock=time.monotonic):
+        if default_qps is None:
+            default_qps = _env_tenant_qps()
+        if default_qps < 0:
+            raise ValueError(
+                f"default_qps must be >= 0, got {default_qps}")
+        self.default_qps = int(default_qps)
+        self.burst = burst
+        self.reserve_frac = float(reserve_frac)
+        self.quotas = dict(quotas or {})
+        self._clock = clock
+        self._buckets: dict[str, TokenBucket | None] = {}
+        self._lock = threading.Lock()
+
+    def bucket(self, tenant: str) -> TokenBucket | None:
+        """The tenant's bucket (created on first use); None = unlimited."""
+        with self._lock:
+            if tenant not in self._buckets:
+                qps = self.quotas.get(tenant, self.default_qps)
+                self._buckets[tenant] = None if not qps else TokenBucket(
+                    qps, self.burst, reserve_frac=self.reserve_frac,
+                    clock=self._clock)
+            return self._buckets[tenant]
+
+    def admit(self, tenant: str, priority: str = "normal",
+              n: int = 1) -> None:
+        """Admit ``n`` requests for ``tenant`` or raise
+        :class:`QuESTBackpressureError` with ``reason="quota"``."""
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
+        b = self.bucket(tenant)
+        if b is not None and not b.take(n, priority=priority):
+            telemetry.inc("admission_rejected_total", n, tenant=tenant,
+                          priority=priority)
+            # the engine-level series too, so one dashboard family shows
+            # every shed request regardless of which layer shed it
+            telemetry.inc("engine_backpressure_total", reason="quota")
+            raise QuESTBackpressureError(
+                f"tenant {tenant!r} is over its admission quota "
+                f"({b.rate:g} req/s, burst {b.burst:g}): rejecting {n} "
+                f"{priority}-priority request(s)", "EnginePool.submit",
+                reason="quota")
+        telemetry.inc("admission_admitted_total", n, tenant=tenant,
+                      priority=priority)
+
+    def note_queued(self, tenant: str, priority: str, n: int = 1) -> None:
+        """Count requests the pool parked (admitted, but no replica could
+        take them yet -- e.g. mid-failover)."""
+        telemetry.inc("admission_queued_total", n, tenant=tenant,
+                      priority=priority)

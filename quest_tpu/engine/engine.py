@@ -368,22 +368,37 @@ class Engine:
         (default) dispatches everything still queued first; ``drain=False``
         resolves pending futures with a typed QuESTCancelledError instead
         (in-flight work still completes). Every accepted future resolves
-        either way -- a waiter blocked on ``result()`` always wakes."""
+        either way -- a waiter blocked on ``result()`` always wakes.
+
+        A QUARANTINED engine never drains: work accepted before the
+        quarantine would otherwise sit behind a batcher the operator has
+        been told to investigate (and, after a hang, one that may be
+        wedged), so ``drain=True`` downgrades to the typed cancellation
+        path -- queued futures resolve promptly with QuESTCancelledError
+        and only in-flight work is waited on."""
+        dropped: list = []
         with self._cv:
+            if drain and self._health == "quarantined":
+                drain = False
             if not drain:
                 while self._q:
-                    req = self._q.popleft()
-                    if not req.fut.done():
-                        # a typed resolution, not Future.cancel(): cancel()
-                        # is a no-op on futures a waiter already holds in
-                        # RUNNING transitions elsewhere, and CancelledError
-                        # carries no context -- this names the drop
-                        req.fut.set_exception(QuESTCancelledError(
-                            "request dropped by Engine.close(drain=False) "
-                            "before dispatch", "Engine.close"))
+                    dropped.append(self._q.popleft())
             self._open = False
             self._cv.notify_all()
-        if self._thread.is_alive():
+        # resolve OUTSIDE the lock: done callbacks (the pool's failover
+        # re-dispatch) may take other locks, and holding self._cv across
+        # arbitrary callbacks invites lock-order inversions
+        for req in dropped:
+            if not req.fut.done():
+                # a typed resolution, not Future.cancel(): cancel() is a
+                # no-op on futures a waiter already holds in RUNNING
+                # transitions elsewhere, and CancelledError carries no
+                # context -- this names the drop
+                req.fut.set_exception(QuESTCancelledError(
+                    "request dropped by Engine.close before dispatch",
+                    "Engine.close"))
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
             self._thread.join()
         telemetry.set_gauge("engine_queue_depth", 0)
         telemetry.event("engine.close", drained=drain)

@@ -1,0 +1,754 @@
+"""Replica-pool serving: health-aware routing, quarantine drain/failover,
+hedged dispatch, and warm replacement spawning.
+
+One :class:`~quest_tpu.engine.engine.Engine` serves one circuit structure
+from one batcher thread; the fleet shape ROADMAP item 1 asks for is many
+replicas serving heterogeneous multi-tenant traffic. :class:`EnginePool`
+is that front-end. It owns N replicas (each a lazily-populated map of
+structure fingerprint -> ``Engine``) and routes every submit by three
+signals, in order:
+
+1. **health** -- the replica's worst engine state plus a pool-level
+   override (``healthy`` routes before ``degraded``; ``quarantined``
+   never routes),
+2. **structure affinity** -- same-fingerprint requests prefer a replica
+   that already holds that executable, so heterogeneous traffic does not
+   serialize behind one batcher (and a cold replica is not warmed by
+   accident on the hot path),
+3. **load** -- least outstanding requests breaks ties.
+
+Robustness behaviors (ISSUE 13):
+
+- **Failover + quarantine drain**: when a replica quarantines (sentinel
+  breach, hang, or an injected ``pool.replica`` fault), the pool pulls it
+  from rotation, closes its engines with ``drain=False`` -- every queued
+  future resolves with a typed
+  :class:`~quest_tpu.resilience.QuESTCancelledError` -- and the done
+  callbacks re-dispatch those requests to healthy peers. No caller future
+  is ever dropped, and the recovered results are bit-identical: the same
+  fingerprint fetches the same executable, and the PR 4 vmap contract
+  makes every batch lane identical. Counted
+  ``pool_failovers_total{reason}``. A replacement replica is then spawned
+  in the background and **warmed from the fingerprint manifest**
+  (:meth:`EnginePool.warm_from_manifest`; with ``QUEST_COMPILE_CACHE``
+  set the compile itself reloads from disk) BEFORE it joins rotation --
+  its first real request performs zero retraces
+  (``engine_trace_total{kind=param_replay}`` stays flat).
+- **Admission control**: every submit passes the per-tenant token-bucket
+  front door first (:mod:`.admission` -- ``QuESTBackpressureError`` with
+  ``reason="quota"``, high-priority reserve band, the
+  ``admission_*_total`` counters). Admitted requests that momentarily
+  have NO routable replica (e.g. mid-failover) park in priority-ordered
+  pending queues (high drains first) instead of being rejected.
+- **Hedged dispatch** (``hedge_ms`` > 0): a request outstanding on a
+  ``degraded`` replica past the hedge deadline is re-issued to a healthy
+  peer through :func:`~quest_tpu.resilience.retry.call_with_retry`
+  (site ``pool.hedge``, retryable on backpressure); first completion
+  wins, the loser's future is cancelled (the engines' own
+  ``fut.done()`` guards make the late result a no-op). Both outcomes are
+  bit-identical by the same executable-identity argument, so hedging
+  never changes answers -- only tail latency.
+  ``pool_hedges_total{outcome=issued|won_primary|won_hedge}``.
+
+Env knobs (all through
+:func:`~quest_tpu.analysis.diagnostics.parse_env_int`, malformed values
+warn once with QT307): ``QUEST_POOL_REPLICAS`` (default 2),
+``QUEST_HEDGE_MS`` (default 0 = hedging off), and ``QUEST_TENANT_QPS``
+(read by :mod:`.admission`).
+
+Telemetry: ``pool_requests_total{tenant,priority}``,
+``pool_routes_total{outcome=affinity|healthy|degraded|parked}``,
+``pool_failovers_total{reason}``, ``pool_quarantines_total{reason}``,
+``pool_replacements_total{reason}``, ``pool_hedges_total{outcome}``, and
+the ``pool_replicas`` rotation gauge, on top of everything the member
+engines already emit.
+
+Locking: the pool condition variable orders BEFORE any engine lock --
+pool code may read engine health under the pool lock, but never holds an
+engine lock while taking the pool lock (engine done callbacks run with
+no engine lock held; ``Engine.close`` resolves cancelled futures after
+releasing its lock for exactly this reason).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+from .. import telemetry
+from ..resilience import faultinject as _faults
+from ..resilience import retry as _retry
+from ..resilience.errors import (QuESTBackpressureError, QuESTCancelledError,
+                                 QuESTHangError, QuESTIntegrityError,
+                                 QuESTRetryError)
+from .admission import PRIORITIES, AdmissionController
+from .engine import Engine
+
+__all__ = ["EnginePool"]
+
+_RANK = {"healthy": 0, "degraded": 1, "quarantined": 2}
+_STATES = ("healthy", "degraded", "quarantined")
+
+#: replica-failure exception -> ``pool_failovers_total{reason}`` label;
+#: anything NOT here (timeouts, poisoned requests, value errors) is a
+#: REQUEST failure and propagates to the caller instead of failing over
+_FAILOVER_REASONS = (
+    (QuESTCancelledError, "drain"),
+    (QuESTHangError, "hang"),
+    (QuESTIntegrityError, "integrity"),
+    (QuESTBackpressureError, "backpressure"),
+)
+
+#: QT307 warn-once tracking, one set per knob so the same malformed raw
+#: value still warns on each distinct knob
+_REPLICAS_WARNED: set = set()
+_HEDGE_WARNED: set = set()
+
+
+def _env_replicas() -> int:
+    from ..analysis.diagnostics import parse_env_int
+    return parse_env_int("QUEST_POOL_REPLICAS", 2, minimum=1, code="QT307",
+                         warned=_REPLICAS_WARNED, noun="replica count")
+
+
+def _env_hedge_ms() -> int:
+    from ..analysis.diagnostics import parse_env_int
+    return parse_env_int("QUEST_HEDGE_MS", 0, minimum=0, code="QT307",
+                         warned=_HEDGE_WARNED, noun="hedge deadline (ms)")
+
+
+def _failover_reason(exc) -> str | None:
+    for cls, reason in _FAILOVER_REASONS:
+        if isinstance(exc, cls):
+            return reason
+    return None
+
+
+class _PoolRequest:
+    """One pool-level request: the caller's future plus everything needed
+    to re-dispatch it (circuit, params, tenant) and the bookkeeping the
+    failover/hedge machinery reads (attempt count, replicas already
+    failed on, in-flight engine futures)."""
+
+    __slots__ = ("circuit", "fingerprint", "params", "tenant", "priority",
+                 "fut", "deadline", "t0", "attempts", "failed", "inner",
+                 "hedged", "dispatched_at", "last_exc", "settled")
+
+    def __init__(self, circuit, fingerprint, params, tenant, priority,
+                 deadline):
+        self.circuit = circuit
+        self.fingerprint = fingerprint
+        self.params = params
+        self.tenant = tenant
+        self.priority = priority
+        self.fut: Future = Future()
+        self.deadline = deadline
+        self.t0 = time.monotonic()
+        self.attempts = 0
+        self.failed: set = set()          # replica ids this request failed on
+        self.inner: list = []             # (replica, engine_future, is_hedge)
+        self.hedged = False
+        self.dispatched_at: float | None = None
+        self.last_exc = None
+        self.settled = False
+
+    def remaining(self) -> float | None:
+        if self.deadline is None:
+            return None
+        return max(0.0, self.deadline - time.monotonic())
+
+
+class _Replica:
+    """One pool member: a map of fingerprint -> Engine, a pool-level state
+    override (quarantine sticks even after its engines are closed), and
+    the outstanding-request set routing and hedging read."""
+
+    __slots__ = ("id", "engines", "state", "in_rotation", "outstanding",
+                 "build_lock")
+
+    def __init__(self, rid: int):
+        self.id = rid
+        self.engines: dict = {}
+        self.state = "healthy"
+        self.in_rotation = False
+        self.outstanding: set = set()
+        self.build_lock = threading.Lock()
+
+    def health(self) -> str:
+        """Worst of the pool-level state and every member engine's
+        health (the routing signal)."""
+        h = _RANK[self.state]
+        for eng in self.engines.values():
+            h = max(h, _RANK[eng.health()])
+        return _STATES[h]
+
+
+class EnginePool:
+    """Health-aware replica pool over :class:`Engine` (module docstring).
+
+    ``env`` and the engine knobs (``max_batch``/``max_delay_ms``/
+    ``queue_max``/``precision_code``/``donate``) are shared by every
+    engine the pool builds. ``replicas`` defaults to
+    ``QUEST_POOL_REPLICAS`` (2), ``hedge_ms`` to ``QUEST_HEDGE_MS``
+    (0 = off); ``admission`` accepts a pre-built
+    :class:`~quest_tpu.engine.admission.AdmissionController` (otherwise
+    one is created from ``tenant_qps`` / ``QUEST_TENANT_QPS``).
+    ``spawn_replacements=False`` disables automatic replacement of
+    quarantined replicas (tests that count replicas exactly use it).
+    """
+
+    def __init__(self, env=None, *, replicas: int | None = None,
+                 max_batch: int = 8, max_delay_ms: float = 2.0,
+                 queue_max: int | None = None, hedge_ms: float | None = None,
+                 tenant_qps: int | None = None, admission=None,
+                 precision_code: int | None = None, donate: bool = True,
+                 spawn_replacements: bool = True):
+        if replicas is None:
+            replicas = _env_replicas()
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if hedge_ms is None:
+            hedge_ms = _env_hedge_ms()
+        if hedge_ms < 0:
+            raise ValueError(f"hedge_ms must be >= 0, got {hedge_ms}")
+        self._env = env
+        self._engine_kw = dict(max_batch=max_batch,
+                               max_delay_ms=max_delay_ms,
+                               queue_max=queue_max,
+                               precision_code=precision_code, donate=donate)
+        self.hedge_s = float(hedge_ms) / 1e3
+        self.admission = (admission if admission is not None
+                          else AdmissionController(tenant_qps))
+        self._spawn_replacements = bool(spawn_replacements)
+        self._cv = threading.Condition()
+        self._replicas: list[_Replica] = []
+        self._manifest: dict = {}         # fingerprint -> circuit
+        self._pending = {p: deque() for p in PRIORITIES}
+        self._next_rid = 0
+        self._closed = False
+        self._max_attempts = max(3, int(replicas) + 2)
+        self._workers: list[threading.Thread] = []
+        for _ in range(int(replicas)):
+            rep = _Replica(self._next_rid)
+            self._next_rid += 1
+            rep.in_rotation = True
+            self._replicas.append(rep)
+        telemetry.set_gauge("pool_replicas", int(replicas))
+        self._hedge_thread = None
+        if self.hedge_s > 0:
+            self._hedge_thread = threading.Thread(
+                target=self._hedge_loop, name="quest-pool-hedge",
+                daemon=True)
+            self._hedge_thread.start()
+        telemetry.event("pool.start", replicas=int(replicas),
+                        hedge_ms=float(hedge_ms))
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, circuit, params: dict | None = None, *,
+               tenant: str = "default", priority: str = "normal",
+               timeout: float | None = None) -> Future:
+        """Admit + route one request; returns a Future resolving to the
+        final planar amplitude array no matter which replica (or how many
+        failovers) served it."""
+        return self.submit_many(circuit, [params], tenant=tenant,
+                                priority=priority, timeout=timeout)[0]
+
+    def submit_many(self, circuit, params_list, *, tenant: str = "default",
+                    priority: str = "normal",
+                    timeout: float | None = None) -> list:
+        """Admit ``len(params_list)`` requests atomically (the quota sees
+        one take), then route each independently."""
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
+        if not params_list:
+            return []
+        if timeout is not None and timeout < 0:
+            raise ValueError(f"timeout must be >= 0, got {timeout}")
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("EnginePool is closed")
+        self.admission.admit(tenant, priority, len(params_list))
+        telemetry.inc("pool_requests_total", len(params_list),
+                      tenant=tenant, priority=priority)
+        fp = circuit.fingerprint()
+        with self._cv:
+            self._manifest.setdefault(fp, circuit)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        futs = []
+        for params in params_list:
+            req = _PoolRequest(circuit, fp, params, tenant, priority,
+                               deadline)
+            futs.append(req.fut)
+            self._route(req)
+        return futs
+
+    def run(self, circuit, params: dict | None = None, **kw):
+        """Synchronous convenience: ``submit(...).result()``."""
+        return self.submit(circuit, params, **kw).result()
+
+    # -- routing ------------------------------------------------------------
+
+    def _select_locked(self, fingerprint, exclude=frozenset(),
+                       allow_degraded: bool = True):
+        """Routing policy (pool lock held): healthiest state first, then
+        structure affinity, then least-loaded; quarantined never routes."""
+        best = best_key = None
+        for rep in self._replicas:
+            if not rep.in_rotation or rep.id in exclude:
+                continue
+            h = rep.health()
+            if h == "quarantined" or (h == "degraded"
+                                      and not allow_degraded):
+                continue
+            # structure-count before id: a cold fingerprint lands on the
+            # replica serving the fewest structures, so heterogeneous
+            # traffic spreads instead of serializing behind one batcher
+            key = (_RANK[h], 0 if fingerprint in rep.engines else 1,
+                   len(rep.outstanding), len(rep.engines), rep.id)
+            if best_key is None or key < best_key:
+                best, best_key = rep, key
+        return best
+
+    def _route(self, req: _PoolRequest) -> None:
+        parked = cancel = False
+        rep = None
+        with self._cv:
+            if self._closed:
+                cancel = True
+            else:
+                rep = self._select_locked(req.fingerprint,
+                                          exclude=req.failed)
+                if rep is None and req.failed:
+                    # every non-failed replica is unroutable; a replica
+                    # this request once failed on may have healed -- a
+                    # stale exclusion must not park the request forever
+                    rep = self._select_locked(req.fingerprint)
+                if rep is None:
+                    telemetry.inc("pool_routes_total", outcome="parked")
+                    self._pending[req.priority].append(req)
+                    parked = True
+                else:
+                    telemetry.inc(
+                        "pool_routes_total",
+                        outcome=("affinity"
+                                 if req.fingerprint in rep.engines
+                                 else rep.health()))
+        if cancel:
+            self._settle(req, exc=QuESTCancelledError(
+                "request dropped: EnginePool is closed",
+                "EnginePool.submit"))
+            return
+        if parked:
+            self.admission.note_queued(req.tenant, req.priority)
+            return
+        self._dispatch_attempt(req, rep)
+
+    def _dispatch_attempt(self, req: _PoolRequest, rep: _Replica) -> None:
+        req.attempts += 1
+        if req.attempts > self._max_attempts:
+            self._settle(req, exc=req.last_exc or QuESTRetryError(
+                f"request failed over {req.attempts - 1} time(s) without "
+                f"a replica completing it", "EnginePool.submit"))
+            return
+        if _faults.enabled():
+            # the injectable replica-death point: one visit per routed
+            # dispatch attempt, so a plan's nth visit replays identically
+            kind = _faults.fire("pool.replica")
+            if kind is not None:
+                req.failed.add(rep.id)
+                req.last_exc = QuESTCancelledError(
+                    f"injected {kind} fault at site 'pool.replica' "
+                    f"(replica {rep.id})", "EnginePool._dispatch")
+                self._quarantine(rep, reason=kind)
+                telemetry.inc("pool_failovers_total", reason=kind)
+                self._route(req)
+                return
+        eng = None
+        try:
+            eng = self._engine_for(rep, req.fingerprint, req.circuit)
+            f = eng.submit(req.params, timeout=req.remaining())
+        except QuESTBackpressureError as e:
+            req.failed.add(rep.id)
+            req.last_exc = e
+            if eng is not None and eng.health() == "quarantined":
+                self._quarantine(rep, reason="quarantined")
+            telemetry.inc("pool_failovers_total", reason="backpressure")
+            self._route(req)
+            return
+        except BaseException as e:
+            self._settle(req, exc=e)
+            return
+        with self._cv:
+            req.dispatched_at = time.monotonic()
+            req.inner.append((rep, f, False))
+            rep.outstanding.add(req)
+        f.add_done_callback(
+            lambda fut, req=req, rep=rep: self._on_done(req, rep, fut,
+                                                        hedge=False))
+
+    def _settle(self, req: _PoolRequest, result=None, exc=None) -> bool:
+        """Resolve the caller's future exactly once (concurrent engine
+        completions race through here; the first wins)."""
+        with self._cv:
+            if req.settled:
+                return False
+            req.settled = True
+            self._cv.notify_all()
+        if exc is not None:
+            req.fut.set_exception(exc)
+        else:
+            req.fut.set_result(result)
+        telemetry.observe("pool_request_latency_seconds",
+                          time.monotonic() - req.t0)
+        return True
+
+    def _on_done(self, req: _PoolRequest, rep: _Replica, fut,
+                 *, hedge: bool) -> None:
+        with self._cv:
+            req.inner = [p for p in req.inner if p[1] is not fut]
+            if not any(p[0] is rep for p in req.inner):
+                rep.outstanding.discard(req)
+            siblings = list(req.inner)
+            settled = req.settled
+            self._cv.notify_all()
+        if fut.cancelled():
+            return  # a hedge loser we cancelled while still queued
+        exc = fut.exception()
+        if settled:
+            return  # hedge loser (or late failover echo): drop silently
+        if exc is None:
+            if self._settle(req, result=fut.result()):
+                if req.hedged:
+                    telemetry.inc("pool_hedges_total",
+                                  outcome=("won_hedge" if hedge
+                                           else "won_primary"))
+                for _rep2, f2, _h in siblings:
+                    f2.cancel()  # engines guard fut.done(): safe either way
+            self._drain_pending()
+            return
+        # a replica-level failure quarantines the replica...
+        if isinstance(exc, QuESTHangError):
+            self._quarantine(rep, reason="hang")
+        elif isinstance(exc, QuESTIntegrityError):
+            with self._cv:
+                state = rep.health()
+            if state == "quarantined":
+                self._quarantine(rep, reason="integrity")
+        if siblings:
+            return  # another attempt is still in flight; let it decide
+        reason = _failover_reason(exc)
+        if reason is None:
+            # request-level failure (timeout, poison, user error): the
+            # caller gets the typed error, no failover
+            self._settle(req, exc=exc)
+            return
+        req.failed.add(rep.id)
+        req.last_exc = exc
+        telemetry.inc("pool_failovers_total", reason=reason)
+        telemetry.event("pool.failover", replica=rep.id, reason=reason,
+                        attempts=req.attempts)
+        self._route(req)
+        self._drain_pending()
+
+    def _drain_pending(self) -> None:
+        """Dispatch parked requests that became routable (high first)."""
+        while True:
+            req = rep = None
+            with self._cv:
+                if self._closed:
+                    return
+                for prio in PRIORITIES:
+                    dq = self._pending[prio]
+                    if dq:
+                        cand = self._select_locked(dq[0].fingerprint,
+                                                   exclude=dq[0].failed) \
+                            or self._select_locked(dq[0].fingerprint)
+                        if cand is not None:
+                            req, rep = dq.popleft(), cand
+                            break
+                if req is None:
+                    return
+            self._dispatch_attempt(req, rep)
+
+    # -- engines ------------------------------------------------------------
+
+    def _engine_for(self, rep: _Replica, fingerprint, circuit=None):
+        with self._cv:
+            eng = rep.engines.get(fingerprint)
+            if circuit is None:
+                circuit = self._manifest.get(fingerprint)
+        if eng is not None:
+            return eng
+        if circuit is None:
+            raise KeyError(f"no circuit recorded for fingerprint "
+                           f"{fingerprint[:12]}...")
+        with rep.build_lock:
+            with self._cv:
+                eng = rep.engines.get(fingerprint)
+            if eng is not None:
+                return eng
+            eng = Engine(circuit, self._env, **self._engine_kw)
+            with self._cv:
+                rep.engines[fingerprint] = eng
+            return eng
+
+    # -- quarantine / failover / replacement --------------------------------
+
+    def _quarantine(self, rep: _Replica, *, reason: str) -> None:
+        with self._cv:
+            if rep.state == "quarantined":
+                return
+            rep.state = "quarantined"
+            rep.in_rotation = False
+            engines = list(rep.engines.values())
+            spawn = self._spawn_replacements and not self._closed
+            self._cv.notify_all()
+        telemetry.inc("pool_quarantines_total", reason=reason)
+        telemetry.set_gauge("pool_replicas", self._rotation_count())
+        telemetry.event("pool.quarantine", replica=rep.id, reason=reason)
+        # drain on a helper thread: _quarantine may be running ON one of
+        # this replica's batcher threads (hang/integrity done callbacks),
+        # and Engine.close joins the batcher
+        drainer = threading.Thread(
+            target=self._drain_replica, args=(engines,),
+            name=f"quest-pool-drain-{rep.id}", daemon=True)
+        drainer.start()
+        with self._cv:
+            self._workers.append(drainer)
+        if spawn:
+            spawner = threading.Thread(
+                target=self._spawn_replacement, args=(reason,),
+                name="quest-pool-respawn", daemon=True)
+            spawner.start()
+            with self._cv:
+                self._workers.append(spawner)
+
+    def _drain_replica(self, engines) -> None:
+        """Close a quarantined replica's engines without draining: every
+        queued future resolves QuESTCancelledError, whose done callbacks
+        fail the requests over to healthy peers (zero dropped futures);
+        in-flight batches complete and still serve their waiters."""
+        for eng in engines:
+            try:
+                eng.close(drain=False)
+            except Exception:  # pragma: no cover - close must not cascade
+                pass
+
+    def _spawn_replacement(self, reason: str) -> None:
+        try:
+            with self._cv:
+                if self._closed:
+                    return
+                rep = _Replica(self._next_rid)
+                self._next_rid += 1
+                manifest = dict(self._manifest)
+            for fp, circ in manifest.items():
+                self._engine_for(rep, fp, circ).warmup()
+        except Exception as e:  # pragma: no cover - respawn best-effort
+            telemetry.event("pool.respawn_failed", error=type(e).__name__)
+            return
+        stillborn = None
+        with self._cv:
+            if self._closed:
+                stillborn = list(rep.engines.values())
+            else:
+                rep.in_rotation = True
+                self._replicas.append(rep)
+                self._cv.notify_all()
+        if stillborn is not None:
+            self._drain_replica(stillborn)
+            return
+        telemetry.inc("pool_replacements_total", reason=reason)
+        telemetry.set_gauge("pool_replicas", self._rotation_count())
+        telemetry.event("pool.replacement", replica=rep.id,
+                        warmed=len(manifest))
+        self._drain_pending()
+
+    def warm_from_manifest(self, manifest=None, replica=None) -> list:
+        """Pre-build and :meth:`Engine.warmup` the executables for every
+        fingerprint in ``manifest`` (default: every structure this pool
+        has served; alternatively a ``{fingerprint: circuit}`` map or an
+        iterable of circuits) on ``replica`` (an id, or None = every
+        in-rotation replica). With ``QUEST_COMPILE_CACHE`` set the warmup
+        compile reloads from disk, so even a fresh process serves its
+        first real request with zero retraces. Returns the warmed
+        fingerprints."""
+        if manifest is None:
+            with self._cv:
+                manifest = dict(self._manifest)
+        elif not isinstance(manifest, dict):
+            manifest = {c.fingerprint(): c for c in manifest}
+        with self._cv:
+            for fp, circ in manifest.items():
+                self._manifest.setdefault(fp, circ)
+            if replica is None:
+                reps = [r for r in self._replicas if r.in_rotation]
+            elif isinstance(replica, _Replica):
+                reps = [replica]
+            else:
+                reps = [r for r in self._replicas if r.id == replica]
+                if not reps:
+                    raise ValueError(f"no replica with id {replica!r}")
+        for rep in reps:
+            for fp, circ in manifest.items():
+                self._engine_for(rep, fp, circ).warmup()
+        return sorted(manifest)
+
+    @property
+    def manifest(self) -> dict:
+        """Fingerprint -> circuit map of every structure served so far."""
+        with self._cv:
+            return dict(self._manifest)
+
+    # -- hedging ------------------------------------------------------------
+
+    def _hedge_loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                cands = []
+                for rep in self._replicas:
+                    if not rep.in_rotation or rep.health() != "degraded":
+                        continue
+                    for req in list(rep.outstanding):
+                        if (req.settled or req.hedged
+                                or req.dispatched_at is None
+                                or now - req.dispatched_at < self.hedge_s):
+                            continue
+                        peer = self._select_locked(
+                            req.fingerprint,
+                            exclude={rep.id} | req.failed,
+                            allow_degraded=False)
+                        if peer is not None:
+                            req.hedged = True
+                            cands.append((req, peer))
+            for req, peer in cands:
+                self._issue_hedge(req, peer)
+            with self._cv:
+                if self._closed:
+                    return
+                self._cv.wait(max(self.hedge_s / 2.0, 0.001))
+
+    def _issue_hedge(self, req: _PoolRequest, peer: _Replica) -> None:
+        telemetry.inc("pool_hedges_total", outcome="issued")
+        telemetry.event("pool.hedge", replica=peer.id,
+                        attempts=req.attempts)
+
+        def attempt():
+            return self._engine_for(peer, req.fingerprint,
+                                    req.circuit).submit(
+                req.params, timeout=req.remaining())
+
+        try:
+            f = _retry.call_with_retry(attempt, site="pool.hedge",
+                                       retryable=(QuESTBackpressureError,))
+        except Exception:
+            with self._cv:
+                req.hedged = False  # primary still owns it; may re-hedge
+            return
+        with self._cv:
+            req.inner.append((peer, f, True))
+            peer.outstanding.add(req)
+        f.add_done_callback(
+            lambda fut, req=req, rep=peer: self._on_done(req, rep, fut,
+                                                         hedge=True))
+
+    # -- introspection / lifecycle ------------------------------------------
+
+    def _rotation_count(self) -> int:
+        with self._cv:
+            return sum(1 for r in self._replicas if r.in_rotation)
+
+    def health(self) -> dict:
+        """Replica id -> health state, quarantined ex-members included."""
+        with self._cv:
+            return {rep.id: rep.health() for rep in self._replicas}
+
+    def rotation(self) -> list:
+        """Ids of the replicas currently accepting traffic."""
+        with self._cv:
+            return [rep.id for rep in self._replicas if rep.in_rotation]
+
+    def await_rotation(self, k: int, timeout: float | None = None) -> int:
+        """Block until at least ``k`` replicas are in rotation (e.g. a
+        replacement finished warming); raises TimeoutError otherwise."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: self._closed or sum(
+                    1 for r in self._replicas if r.in_rotation) >= k,
+                timeout)
+            count = sum(1 for r in self._replicas if r.in_rotation)
+        if not ok or count < k:
+            raise TimeoutError(
+                f"pool rotation did not reach {k} (have {count})")
+        return count
+
+    def revive(self, replica_id: int) -> str:
+        """Operator acknowledgement after a quarantine: return the
+        replica to rotation. Engines its drain closed are discarded (they
+        rebuild lazily, warm via the executable LRU); surviving engines
+        are :meth:`Engine.revive`-d. Returns the replica's new health."""
+        with self._cv:
+            reps = [r for r in self._replicas if r.id == replica_id]
+            if not reps:
+                raise ValueError(f"no replica with id {replica_id!r}")
+            rep = reps[0]
+            rep.state = "healthy"
+            for fp in [fp for fp, e in rep.engines.items()
+                       if not e._open]:
+                del rep.engines[fp]
+            engines = list(rep.engines.values())
+        for eng in engines:
+            eng.revive()
+        with self._cv:
+            rep.in_rotation = True
+            self._cv.notify_all()
+        telemetry.set_gauge("pool_replicas", self._rotation_count())
+        telemetry.event("pool.revive", replica=rep.id)
+        self._drain_pending()
+        with self._cv:
+            return rep.health()
+
+    def close(self, drain: bool = True) -> None:
+        """Close every engine on every replica (``drain`` as in
+        :meth:`Engine.close`); parked pending requests resolve with a
+        typed QuESTCancelledError. Every accepted future resolves."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            parked = [r for p in PRIORITIES for r in self._pending[p]]
+            for p in PRIORITIES:
+                self._pending[p].clear()
+            reps = list(self._replicas)
+            workers = list(self._workers)
+            self._cv.notify_all()
+        for req in parked:
+            self._settle(req, exc=QuESTCancelledError(
+                "request dropped by EnginePool.close before dispatch",
+                "EnginePool.close"))
+        for t in workers:
+            t.join()
+        for rep in reps:
+            for eng in list(rep.engines.values()):
+                try:
+                    eng.close(drain=drain)
+                except Exception:  # pragma: no cover
+                    pass
+        if self._hedge_thread is not None and self._hedge_thread.is_alive():
+            self._hedge_thread.join()
+        telemetry.set_gauge("pool_replicas", 0)
+        telemetry.event("pool.close", drained=drain)
+
+    def __enter__(self) -> "EnginePool":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close(drain=exc_type is None)
+        return False

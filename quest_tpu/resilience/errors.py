@@ -33,8 +33,18 @@ class QuESTTimeoutError(QuESTError):
 
 
 class QuESTBackpressureError(QuESTError):
-    """The engine queue is at ``QUEST_ENGINE_QUEUE_MAX``; the submit was
-    rejected rather than growing the queue unboundedly."""
+    """The submit was rejected rather than growing a queue unboundedly:
+    the engine queue is at ``QUEST_ENGINE_QUEUE_MAX``, the engine is
+    quarantined, or a tenant's admission quota is spent.
+
+    ``reason`` mirrors the ``engine_backpressure_total{reason}`` label:
+    ``"queue_full"`` | ``"quarantined"`` | ``"quota"`` |
+    ``"pool_capacity"`` (None on legacy raisers)."""
+
+    def __init__(self, message: str, func: str = "",
+                 reason: str | None = None):
+        super().__init__(message, func)
+        self.reason = reason
 
 
 class QuESTCancelledError(QuESTError):

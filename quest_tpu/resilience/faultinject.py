@@ -33,6 +33,13 @@ site                 kinds                   raised / effect
                                              engine dispatch; the
                                              watchdog quarantines the
                                              engine (QuESTHangError)
+``pool.replica``     ``kill, hang``          abrupt replica death / hang
+                                             at the pool's routing visit:
+                                             the EnginePool quarantines
+                                             the replica and fails its
+                                             queued + in-flight-unacked
+                                             requests over to healthy
+                                             peers (engine/pool.py)
 ``checkpoint.write`` ``torn, corrupt, io``   truncate / bit-flip the
                                              just-written shard; ``io``
                                              raises TransientFault
@@ -82,6 +89,7 @@ SITES: dict[str, tuple[str, ...]] = {
     "exchange.collective": ("transient", "hang"),
     "engine.request": ("poison",),
     "engine.dispatch": ("hang",),
+    "pool.replica": ("kill", "hang"),
     "checkpoint.write": ("torn", "corrupt", "io"),
     "segment.boundary": ("preempt",),
     "state.corrupt": ("bitflip",),

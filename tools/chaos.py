@@ -1,9 +1,10 @@
-"""Chaos scenario suite for the resilience layer (ISSUE 7 + 8).
+"""Chaos scenario suite for the resilience layer (ISSUE 7 + 8 + 13).
 
 Each scenario arms one fault class through ``quest_tpu.resilience``'s
 injection plan, runs a real circuit through the hardened path, and
 asserts BOTH the recovery behavior (retry / degrade / isolate / resume /
-rollback-and-replay / watchdog) and the final-state contract
+rollback-and-replay / watchdog / replica failover) and the final-state
+contract
 (bit-identity to the clean run, or allclose-to-oracle where the degrade
 lattice legitimately changes the compute order). This is the executable
 form of the failure-mode table in docs/resilience.md, run in CI next to
@@ -306,6 +307,59 @@ def collective_hang_watchdog(env, env8):
     assert telemetry.counter_value("watchdog_timeouts_total",
                                    site="exchange.collective") == 1
     return {"hang_failed_typed": True, "deadline_ms": 200}
+
+
+@scenario
+def replica_failover(env, env8):
+    """ISSUE 13: an injected replica kill mid-load quarantines the
+    replica; its queued work fails over to the healthy peer with ZERO
+    lost futures and every recovered result bit-identical to the clean
+    oracle; the warmed replacement replica joins rotation and serves its
+    first request with zero retraces."""
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.engine import EnginePool
+    from quest_tpu.resilience import fault_plan
+
+    c = Circuit(3)
+    for q in range(3):
+        c.rotateY(q, qt.P(f"t{q}"))
+    c.controlledNot(0, 1)
+    c.controlledNot(1, 2)
+    plist = [{f"t{q}": 0.11 * q + 0.07 * i for q in range(3)}
+             for i in range(8)]
+    with qt.Engine(c, env, max_batch=4, max_delay_ms=0.0) as eng:
+        oracle = [np.asarray(f.result(timeout=120))
+                  for f in [eng.submit(p) for p in plist]]
+    telemetry.reset()
+    with EnginePool(env, replicas=2, max_batch=4, max_delay_ms=0.0) as pool:
+        with fault_plan("pool.replica:kill:3"):
+            futs = pool.submit_many(c, plist)
+            got = [np.asarray(f.result(timeout=120)) for f in futs]
+        lost = sum(1 for f in futs if not f.done())
+        assert lost == 0, f"{lost} futures lost in failover"
+        for i, (w, g) in enumerate(zip(oracle, got)):
+            assert np.array_equal(w, g), f"recovered request {i} diverged"
+        failovers = telemetry.counter_value("pool_failovers_total",
+                                            reason="kill")
+        assert failovers >= 1, "injected kill never failed over"
+        pool.await_rotation(2, timeout=300)  # replacement warmed + rotated
+        assert telemetry.counter_value("pool_replacements_total",
+                                       reason="kill") == 1
+        new_rep = max(pool._replicas, key=lambda r: r.id)
+        tr0 = telemetry.counter_value("engine_trace_total",
+                                      kind="param_replay")
+        first = np.asarray(
+            new_rep.engines[c.fingerprint()].submit(plist[0]).result(
+                timeout=120))
+        assert telemetry.counter_value(
+            "engine_trace_total", kind="param_replay") == tr0, \
+            "replacement retraced on its first request"
+        assert np.array_equal(oracle[0], first), "replacement diverged"
+    return {"lost_requests": 0, "failover_bitident": True,
+            "failovers": int(failovers), "replacement_zero_retrace": True,
+            "checksum": _checksum(got[0])}
 
 
 def main() -> int:
