@@ -129,6 +129,13 @@ def smoke_plan_specs() -> list:
          "build": lambda: build_circuit(20, 2),
          "mesh_shape": (8,), "dtype": None, "fused": None,
          "comm_pipeline": 4},
+        # the two-slice hierarchical route (ISSUE 14): the schedule check
+        # re-prices the journal under the two-tier (kind, link) model and
+        # proves the once-per-reconcile DCN rule (QT108)
+        {"name": "plan_20q_2slice",
+         "build": lambda: build_circuit(20, 4),
+         "mesh_shape": (8,), "dtype": None, "fused": None,
+         "num_slices": 2, "hierarchical": True, "comm_pipeline_dcn": 2},
     ]
 
 
@@ -774,6 +781,68 @@ def plan_20q_relocation_smoke() -> dict:
             "per_swap_chunks": round(comm_chunks(per_swap), 4),
             "telemetry_chunk_units": round(t1 - t0, 6),
             "model_matches_telemetry": bool(abs((t1 - t0) - model) < 1e-6),
+        },
+    }
+
+
+def plan_34q_2slice() -> dict:
+    """CI-gate config (round 15): the 34q deferred plan on a modeled
+    2x8 TWO-SLICE mesh (16 devices, slice-major order: shard bits 30-32
+    ride ICI, bit 33 crosses DCN), flat vs hierarchical A/B split by
+    link class. The hierarchical planner defers every DCN relocation to
+    its forced dense use, fattens the all-to-all it rides, and parks the
+    globally most-idle qubit on the DCN bit -- the bench-smoke gate
+    asserts ``dcn_chunks_hierarchical < dcn_chunks_flat`` and the
+    per-(kind, link) telemetry == model cross-check
+    (.github/workflows/native.yml). Pure jax.eval_shape: no devices."""
+    from quest_tpu import telemetry
+    from quest_tpu._compat import abstract_mesh
+    from quest_tpu.environment import AMP_AXIS
+    from quest_tpu.parallel.scheduler import comm_chunks, plan_circuit
+
+    mesh = abstract_mesh((16,), (AMP_AXIS,))
+    circ = build_circuit(34, 8)
+    flat = plan_circuit(circ, mesh, num_slices=2)
+    t0 = dict(telemetry.counters("comm_chunk_units_total"))
+    hier = plan_circuit(circ, mesh, num_slices=2, hierarchical=True)
+    t1 = telemetry.counters("comm_chunk_units_total")
+    # the hierarchical run's per-(kind, link) telemetry deltas must sum
+    # to the plan model cell-for-cell (the round-15 split of the older
+    # scalar model==telemetry gate)
+    seen = {}
+    for key, v in t1.items():
+        dv = v - t0.get(key, 0.0)
+        if abs(dv) < 1e-12:
+            continue
+        kind = key.split("kind=", 1)[1].split(",", 1)[0].rstrip("}")
+        link = key.split("link=", 1)[1].split(",", 1)[0].rstrip("}")
+        seen[f"{kind}/{link}"] = dv
+    cells = hier["chunks_by_kind_link"]
+    cells_match = set(seen) == set(cells) and all(
+        abs(seen[c] - cells[c]) < 1e-6 for c in cells)
+    return {
+        "config": "plan_34q_2slice",
+        "metric": "34q deferred plan DCN chunk-units, hierarchical "
+                  "two-tier planner (modeled 2x8 two-slice mesh)",
+        "value": round(hier["dcn_chunks"], 4),
+        "unit": "chunk-units",
+        "vs_baseline": None,
+        "detail": {
+            "dcn_chunks_flat": round(flat["dcn_chunks"], 4),
+            "dcn_chunks_hierarchical": round(hier["dcn_chunks"], 4),
+            "ici_chunks_flat": round(flat["ici_chunks"], 4),
+            "ici_chunks_hierarchical": round(hier["ici_chunks"], 4),
+            "total_chunks_flat": round(comm_chunks(flat), 4),
+            "total_chunks_hierarchical": round(comm_chunks(hier), 4),
+            "dcn_reduction_pct": round(
+                100 * (1 - hier["dcn_chunks"] /
+                       max(flat["dcn_chunks"], 1e-12)), 1),
+            "relocation_batches_flat": flat["relocation_batches"],
+            "relocation_batches_hierarchical": hier["relocation_batches"],
+            "staged_relays": hier["staged_relays"],
+            "chunks_by_kind_link_hierarchical":
+                {k: round(v, 4) for k, v in cells.items()},
+            "model_matches_telemetry": bool(cells_match),
         },
     }
 
@@ -1880,6 +1949,10 @@ def main() -> None:
             # the CI bench-smoke gate asserts this config's relocation
             # A/B fields and its telemetry-vs-model cross-check
             cfgs.append(plan_20q_relocation_smoke())
+            # ... and the two-slice row: hierarchical DCN chunk-units
+            # strictly below flat on the modeled 2x8 mesh, per-(kind,
+            # link) telemetry == model (ISSUE 14 gate)
+            cfgs.append(plan_34q_2slice())
             # ... and the serving engine's serve_20q row: cached-replay
             # vs cold-compile ratio, batch-vs-loop bit-identity, zero
             # warm retraces, executable-cache hit counters
@@ -1954,6 +2027,7 @@ def main() -> None:
                "PallasRuns for v5p-16 execution"))
     configs.append(plan_17q_density_distributed())
     configs.append(plan_20q_relocation_smoke())
+    configs.append(plan_34q_2slice())
     configs.append(bench_serving(20, 4, args.reps))
     configs.append(_subprocess_config(
         ["--config", "plan_f64"], budget_s=1200,

@@ -106,6 +106,9 @@ def check_smoke_spec(spec: dict) -> list:
         sched_findings, _stats, _journal = check_circuit_comm(
             target, mesh, dtype=spec.get("dtype"),
             comm_pipeline=spec.get("comm_pipeline"),
+            num_slices=int(spec.get("num_slices", 1)),
+            hierarchical=bool(spec.get("hierarchical", False)),
+            comm_pipeline_dcn=spec.get("comm_pipeline_dcn"),
             location=f"{name}.schedule")
         findings += sched_findings
     return findings

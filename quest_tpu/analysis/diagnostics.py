@@ -91,6 +91,12 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "the item, in FusePlan order (quest_tpu.segments."
               "stamp_plan); re-stamp via Circuit.fused or drop the "
               "stamps (None skips the check per item)"),
+    "QT108": ("warning", "DCN shard bit moved more than once inside one "
+                         "reconciliation",
+              "a hierarchical reconcile should touch each DCN-crossing "
+              "bit at most once (path-decompose swap chains with the DCN "
+              "position as an endpoint, or fold the crossings into one "
+              "grouped collective); plan with hierarchical=True"),
     # -- QT2xx: kernel / DMA ring -------------------------------------------
     "QT201": ("error", "DMA ring load-slot hazard",
               "a ring slot's load must start, be waited, and be consumed "
@@ -124,6 +130,11 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "the effective depth is the largest power of two not above "
               "the requested depth and the chunk's slice limit; request "
               "a smaller depth to silence this"),
+    "QT210": ("warning", "QUEST_COMM_PIPELINE_DCN is malformed or out of "
+                         "range",
+              "set QUEST_COMM_PIPELINE_DCN to an integer >= 1 (unset "
+              "inherits the base QUEST_COMM_PIPELINE depth); the "
+              "malformed value was replaced"),
     # -- QT3xx: resilience (fault injection, retry, segmented runs) ---------
     "QT301": ("error", "multi-host initialization timed out or failed "
                        "against the coordinator",
@@ -263,7 +274,8 @@ def parse_env_int(env: str, default: int, *, minimum: int, code: str,
     (so each knob warns per process, not per launch). The silent coercion
     stays -- the caller must still launch -- but it is no longer silent.
     Shared by ``QUEST_PALLAS_RING`` (QT205), ``QUEST_COMM_PIPELINE``
-    (QT206), ``QUEST_SEGMENT_DISPATCH`` (QT306) and the replica-pool
+    (QT206), ``QUEST_COMM_PIPELINE_DCN`` (QT210),
+    ``QUEST_SEGMENT_DISPATCH`` (QT306) and the replica-pool
     knobs ``QUEST_POOL_REPLICAS`` / ``QUEST_HEDGE_MS`` /
     ``QUEST_TENANT_QPS`` (QT307) instead of per-knob hand-rolled
     parsers."""

@@ -219,13 +219,24 @@ def check_tape(tape, nsv: int, **kwargs) -> list[Finding]:
 
 
 def check_schedule(journal: list, stats: dict, n: int, mesh, *,
+                   num_slices: int = 1,
                    location: str = "schedule") -> list[Finding]:
     """Re-price and layout-replay a scheduler journal against its
     ``plan_circuit`` stats (see the module docstring). ``journal`` is the
     record list a :class:`..parallel.scheduler.DistributedScheduler`
-    collects when its ``journal`` attribute is set."""
+    collects when its ``journal`` attribute is set.
+
+    Round 15 (two-tier model): ``num_slices`` reproduces the scheduler's
+    ICI/DCN shard-bit split, and the replay additionally re-derives the
+    per-``(kind, link)`` chunk-unit cells from the records alone (the
+    same even-split attribution the scheduler's accounting uses),
+    proving ``stats["chunks_by_kind_link"]`` against the journal, and
+    counts how often each DCN shard bit moves inside one reconciliation
+    chain -- more than once means the chain decomposition crossed the
+    slow link redundantly where the path decomposition would not
+    (QT108)."""
     from ..parallel import exchange as X
-    from ..parallel.mesh import local_qubit_count
+    from ..parallel.mesh import local_qubit_count, shard_bit_link
     from ..parallel.scheduler import _swap_price
 
     findings: list[Finding] = []
@@ -242,6 +253,31 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
               "relocation_swaps": 0, "virtual_swaps": 0,
               "reconcile_chunks": 0.0, "relocation_batch_chunks": 0.0,
               "frame_transpose_chunks": 0.0}
+    cells: dict[str, float] = {}  # re-derived chunks_by_kind_link
+
+    def count_cell(kind: str, qubit: int, chunks: float) -> None:
+        link = shard_bit_link(n, mesh, num_slices, qubit)
+        cell = f"{kind}/{link or 'local'}"
+        cells[cell] = cells.get(cell, 0.0) + chunks
+
+    def count_permute_cells(rn, source, scale, kind) -> None:
+        # mirror the scheduler's even-split attribution: the grouped
+        # all-to-all's volume over the crossing bits, the relabel
+        # ppermute's 2 units over the relabeled bits
+        cross = [q for q in range(nl, rn) if source[q] < nl]
+        if cross:
+            share = 2.0 * (1.0 - 0.5 ** len(cross)) * scale / len(cross)
+            for q in cross:
+                count_cell(kind, q, share)
+        moved = [q for q in range(nl, rn)
+                 if source[q] >= nl and source[q] != q]
+        if moved:
+            for q in moved:
+                count_cell(kind, q, 2.0 * scale / len(moved))
+
+    # QT108: DCN shard-bit touch count inside the CURRENT reconciliation
+    # chain (reconcile_swap records up to the next reconcile_done)
+    recon_dcn_touch: dict[int, int] = {}
 
     for idx, rec in enumerate(journal):
         where = f"{location}[{idx}]:{rec[0]}"
@@ -251,17 +287,21 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
             # chunk-units -- the depth-invariance proof the re-priced
             # totals below then complete (any depth, same model) -- and
             # its transfer/compute interleaving must simulate hazard-free
-            # (commcheck QT207/QT208)
-            _, depth = rec
-            if not isinstance(depth, int) or depth < 1:
-                findings.append(make_finding(
-                    "QT103", f"comm_pipeline stamp {depth!r} is not a "
-                             f"depth >= 1", where))
-            else:
-                from .commcheck import check_comm_pipeline
-                findings.extend(check_comm_pipeline(
-                    depth, 1 << nl, location=where))
+            # (commcheck QT207/QT208). Round 15: a two-slice schedule
+            # stamps (base, dcn) -- both depths must verify; pre-round-15
+            # journals carry the 2-tuple form
+            for depth in rec[1:]:
+                if not isinstance(depth, int) or depth < 1:
+                    findings.append(make_finding(
+                        "QT103", f"comm_pipeline stamp {depth!r} is not "
+                                 f"a depth >= 1", where))
+                else:
+                    from .commcheck import check_comm_pipeline
+                    findings.extend(check_comm_pipeline(
+                        depth, 1 << nl, location=where))
         elif kind == "pair_exchange":
+            _, rn, q = rec
+            count_cell("pair_exchange", q, 2.0)
             totals["pair_exchanges"] += 1
         elif kind == "rank_permute":
             _, rn, q = rec
@@ -270,6 +310,7 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
                     "QT103", f"rank permute on local position {q} "
                              f"(< {nl}) would be free, not 2 units",
                     where))
+            count_cell("grouped_permute", q, 2.0)
             totals["rank_permutes"] += 1
         elif kind == "dist_swap":
             _, rn, a, b, tracked = rec
@@ -280,6 +321,7 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
                     f"dist_swap({a},{b}) priced {price} chunk-units; "
                     f"the relocation path budgets exactly 1.0 "
                     f"(one local, one sharded position)", where))
+            count_cell("dist_swap", max(a, b), 1.0)
             totals["relocation_swaps"] += 1
             if tracked:
                 shadow_swap(a, b)
@@ -287,9 +329,27 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
             _, p1, p2 = rec
             totals["virtual_swaps"] += 1
             shadow_swap(p1, p2)
+        elif kind == "staged_relay":
+            # zero-cost marker: the next three dist_swap/reconcile_swap
+            # records are one ICI-relayed cross-slice exchange; the swaps
+            # themselves carry the pricing
+            _, rn, a, b, r = rec
+            if not (shard_bit_link(n, mesh, num_slices, max(a, b)) ==
+                    "dcn" and r < nl):
+                findings.append(make_finding(
+                    "QT103",
+                    f"staged_relay({a},{b} via {r}) does not stage a "
+                    f"DCN-crossing swap through a local relay slot",
+                    where))
         elif kind == "reconcile_swap":
             _, rn, a, b = rec
-            totals["reconcile_chunks"] += _swap_price(a, b, nl)
+            price = _swap_price(a, b, nl)
+            if price:
+                count_cell("reconciliation", max(a, b), price)
+            totals["reconcile_chunks"] += price
+            for q in (a, b):
+                if shard_bit_link(n, mesh, num_slices, q) == "dcn":
+                    recon_dcn_touch[q] = recon_dcn_touch.get(q, 0) + 1
             shadow_swap(a, b)
         elif kind == "permute":
             _, rn, source, scale, pkind = rec
@@ -297,6 +357,7 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
             units = cstats["chunk_units"] * float(scale)
             if pkind == "reconciliation":
                 totals["reconcile_chunks"] += units
+                count_permute_cells(rn, source, float(scale), pkind)
                 if tuple(pos) != tuple(source):
                     findings.append(make_finding(
                         "QT104",
@@ -307,6 +368,11 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
                 occ = list(range(rn))
             elif pkind == "relocation_batch":
                 totals["relocation_batch_chunks"] += units
+                # even split over the batch's sharded positions (every
+                # pair swaps one sharded with one local slot)
+                touched = [q for q in range(nl, rn) if source[q] != q]
+                for q in touched:
+                    count_cell(pkind, q, units / len(touched))
                 for a in range(rn):
                     b = source[a]
                     if a < b:
@@ -316,6 +382,7 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
                 # the scheduler's logical layout (the pallas plan itself
                 # carries the frame); only the pricing is checked
                 totals["frame_transpose_chunks"] += units
+                count_permute_cells(rn, source, float(scale), pkind)
             else:
                 findings.append(make_finding(
                     "QT103", f"unknown permute kind {pkind!r}", where))
@@ -338,6 +405,16 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
                     f"qubits {moved[:8]} displaced: the previous span "
                     f"did not reconcile", where))
         elif kind == "reconcile_done":
+            for q, cnt in sorted(recon_dcn_touch.items()):
+                if cnt > 1:
+                    findings.append(make_finding(
+                        "QT108",
+                        f"DCN shard bit {q} moved {cnt} times inside one "
+                        f"reconciliation chain: the cycle decomposition "
+                        f"crossed the inter-slice link redundantly "
+                        f"(hierarchical=True path-decomposes each cycle "
+                        f"to touch the DCN bit once)", where))
+            recon_dcn_touch = {}
             if pos != list(range(n)):
                 moved = [q for q in range(n) if pos[q] != q]
                 findings.append(make_finding(
@@ -368,6 +445,17 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
                 f"recomputed {key} = {totals[key]:.6g} chunk-units but "
                 f"the plan stats claim {float(stats.get(key, 0.0)):.6g}",
                 f"{location}.totals"))
+    claimed = stats.get("chunks_by_kind_link")
+    if claimed is not None:
+        for cell in sorted(set(cells) | set(claimed)):
+            got, want = cells.get(cell, 0.0), float(claimed.get(cell, 0.0))
+            if abs(got - want) > _TOL:
+                findings.append(make_finding(
+                    "QT103",
+                    f"re-derived chunk-unit cell {cell} = {got:.6g} but "
+                    f"the plan stats claim {want:.6g}: the two-tier "
+                    f"(kind, link) attribution diverged from the "
+                    f"journal", f"{location}.totals"))
     if pos != list(range(n)):
         moved = [q for q in range(n) if pos[q] != q]
         findings.append(make_finding(
@@ -382,11 +470,17 @@ def check_circuit_comm(circuit, mesh, *, num_slices: int = 1,
                        collective_reconcile: bool = True,
                        batch_relocations: bool = True,
                        comm_pipeline: int | None = None,
+                       hierarchical: bool = False,
+                       comm_pipeline_dcn: int | None = None,
                        location: str = "plan_circuit"):
     """Plan ``circuit`` abstractly (zero devices) with journaling on and
     verify the journal against the returned stats (``comm_pipeline``
     stamps the depth into the journal; the re-priced totals prove the
-    model is depth-invariant). Returns ``(findings, stats, journal)``."""
+    model is depth-invariant). ``hierarchical``/``comm_pipeline_dcn``/
+    ``num_slices`` select the two-tier route (round 15); the journal is
+    then additionally checked under the per-(kind, link) attribution and
+    the QT108 once-per-reconcile DCN rule. Returns
+    ``(findings, stats, journal)``."""
     from ..parallel.scheduler import plan_circuit
 
     journal: list = []
@@ -395,7 +489,10 @@ def check_circuit_comm(circuit, mesh, *, num_slices: int = 1,
                          collective_reconcile=collective_reconcile,
                          batch_relocations=batch_relocations,
                          dtype=dtype, journal=journal,
-                         comm_pipeline=comm_pipeline)
+                         comm_pipeline=comm_pipeline,
+                         hierarchical=hierarchical,
+                         comm_pipeline_dcn=comm_pipeline_dcn)
     n = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
-    findings = check_schedule(journal, stats, n, mesh, location=location)
+    findings = check_schedule(journal, stats, n, mesh,
+                              num_slices=num_slices, location=location)
     return findings, stats, journal

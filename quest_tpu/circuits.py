@@ -449,7 +449,8 @@ class Circuit:
     def fused(self, max_qubits: int = 5, dtype=None,
               pallas: bool = False, shard_devices: int | None = None,
               ring_depth: int | None = None,
-              comm_pipeline: int | None = None) -> "Circuit":
+              comm_pipeline: int | None = None,
+              comm_pipeline_dcn: int | None = None) -> "Circuit":
         """A new Circuit with runs of gates contracted into ``max_qubits``-
         qubit unitaries at trace time (see :mod:`quest_tpu.fusion`).
 
@@ -479,6 +480,12 @@ class Circuit:
         plan's frame relabelings ride the explicit scheduler's grouped
         collectives. Bit-identical at every depth; 1 = the monolithic
         launch. None leaves the process default in charge.
+
+        ``comm_pipeline_dcn`` (round 15) is the per-link-class refinement:
+        sub-collectives that cross a DCN shard bit (num_slices > 1 under
+        the explicit scheduler) pipeline at this depth while ICI ones keep
+        ``comm_pipeline``. None defers to QUEST_COMM_PIPELINE_DCN, then to
+        the base depth (parallel.exchange.resolve_pipeline_dcn).
         """
         import numpy as np
 
@@ -541,6 +548,10 @@ class Circuit:
             for item in p.items:
                 if isinstance(item, (fusion.PallasRun, fusion.FrameSwap)):
                     item.comm_pipeline = int(comm_pipeline)
+        if comm_pipeline_dcn is not None:
+            for item in p.items:
+                if isinstance(item, (fusion.PallasRun, fusion.FrameSwap)):
+                    item.comm_pipeline_dcn = int(comm_pipeline_dcn)
         # round 13: stamp each frame-carrying item with its frame-identity
         # segment index (the single-dispatch segment programs' seams;
         # plancheck QT107 re-derives and cross-checks the stamps)

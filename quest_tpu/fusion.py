@@ -461,6 +461,12 @@ class PallasRun:
     #: checks it). Plan-time annotation only -- ignored at apply time;
     #: None on pre-round-13 tapes and unplanned items.
     seg: int | None = None
+    #: per-link-class pipeline depth (round 15): sub-collectives of this
+    #: run's frame relabelings that cross a DCN shard bit pipeline at
+    #: this depth instead of ``comm_pipeline`` (None = inherit --
+    #: QUEST_COMM_PIPELINE_DCN env, else the base depth). Encoded LAST
+    #: in the tape entry; pre-round-15 tapes decode to None.
+    comm_pipeline_dcn: int | None = None
 
 
 @dataclass
@@ -481,6 +487,8 @@ class FrameSwap:
     comm_pipeline: int | None = None
     #: frame-identity segment index (see PallasRun.seg)
     seg: int | None = None
+    #: DCN-crossing pipeline depth (round 15; see PallasRun)
+    comm_pipeline_dcn: int | None = None
 
 
 def _window(qubits) -> tuple:
@@ -1092,16 +1100,20 @@ def plan_from_tape(tape) -> FusePlan:
             rd = a[6] if len(a) > 6 else None
             cp = a[7] if len(a) > 7 else None
             sg = a[8] if len(a) > 8 else None
+            cpd = a[9] if len(a) > 9 else None
             p.items.append(PallasRun(tuple(ops), tb, load_swap_k=lk,
                                      store_swap_k=sk, load_swap_hi=lh,
                                      store_swap_hi=sh, ring_depth=rd,
-                                     comm_pipeline=cp, seg=sg))
+                                     comm_pipeline=cp, seg=sg,
+                                     comm_pipeline_dcn=cpd))
         elif name == "_apply_frame_swap":
             tb, k, hi = a[:3]
             p.items.append(FrameSwap(tb, k, hi,
                                      comm_pipeline=(a[3] if len(a) > 3
                                                     else None),
-                                     seg=(a[4] if len(a) > 4 else None)))
+                                     seg=(a[4] if len(a) > 4 else None),
+                                     comm_pipeline_dcn=(a[5] if len(a) > 5
+                                                        else None)))
         elif name == "_apply_dense_block":
             p.items.append(FusedBlock(tuple(a[1]), a[0]))
         elif name == "_apply_gate_diag":
@@ -1302,7 +1314,8 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
                       store_swap_hi: int | None = None,
                       ring_depth: int | None = None,
                       comm_pipeline: int | None = None,
-                      seg: int | None = None) -> None:
+                      seg: int | None = None,
+                      comm_pipeline_dcn: int | None = None) -> None:
     """Tape-entry wrapper for a PallasRun. Ops are RAW kernel ops over the
     full flattened state: density plans carry explicit conj-shadow twins
     (fusion._shadow_pop), so no path here re-derives shadows.
@@ -1368,7 +1381,8 @@ def _apply_pallas_run(qureg, ops: tuple, tile_bits: int,
         res = _guard.pallas_dispatch(
             lambda: _sched_df_pallas_run(
                 qureg, ops, sched, tile_bits, load_swap_k, store_swap_k,
-                load_swap_hi, store_swap_hi, ring_depth, comm_pipeline),
+                load_swap_hi, store_swap_hi, ring_depth, comm_pipeline,
+                comm_pipeline_dcn),
             degrade=lambda: None)
         if res is not _guard.DEGRADED and res:
             return
@@ -1742,7 +1756,8 @@ def _dispatch_pallas_sharded(qureg, ops: tuple, mesh, tile_bits: int,
 
 def _sched_df_pallas_run(qureg, ops: tuple, sched, tile_bits: int,
                          lk: int, sk: int, lh, sh, ring_depth,
-                         comm_pipeline=None) -> bool:
+                         comm_pipeline=None,
+                         comm_pipeline_dcn=None) -> bool:
     """Explicit-scheduler route for a PallasRun on a sharded PRECISION=2
     register (the ISSUE 3 tentpole): df-split ONCE, run the fused df
     kernels per shard over the scheduler's mesh, and execute the run's
@@ -1771,7 +1786,7 @@ def _sched_df_pallas_run(qureg, ops: tuple, sched, tile_bits: int,
         planes = sched.apply_frame_permute(
             planes, n=nsv, lo1=tile_bits - lk,
             lo2=tile_bits if lh is None else lh, k=lk,
-            pipeline=comm_pipeline)
+            pipeline=comm_pipeline, pipeline_dcn=comm_pipeline_dcn)
     run = _df_shard_chunks(ops, n_local, sublanes, ring_depth=ring_depth)
 
     def body(x):
@@ -1784,7 +1799,7 @@ def _sched_df_pallas_run(qureg, ops: tuple, sched, tile_bits: int,
         planes = sched.apply_frame_permute(
             planes, n=nsv, lo1=tile_bits - sk,
             lo2=tile_bits if sh is None else sh, k=sk,
-            pipeline=comm_pipeline)
+            pipeline=comm_pipeline, pipeline_dcn=comm_pipeline_dcn)
     qureg.put(df_join(planes))
     return True
 
@@ -1931,7 +1946,8 @@ def _apply_dense_block(qureg, U: np.ndarray, qubits: tuple) -> None:
 def _apply_frame_swap(qureg, tile_bits: int, k: int,
                       hi: int | None = None,
                       comm_pipeline: int | None = None,
-                      seg: int | None = None) -> None:
+                      seg: int | None = None,
+                      comm_pipeline_dcn: int | None = None) -> None:
     """Tape-entry wrapper for FrameSwap: one relabeling transpose. Works on
     every backend (plain XLA); on a sharded register GSPMD lowers it to the
     all-to-all the relabeling implies (shard-local when [hi, hi+k) avoids
@@ -1949,7 +1965,7 @@ def _apply_frame_swap(qureg, tile_bits: int, k: int,
         qureg.put(sched.apply_frame_permute(
             qureg.amps, n=nsv, lo1=tile_bits - k,
             lo2=tile_bits if hi is None else hi, k=k,
-            pipeline=comm_pipeline))
+            pipeline=comm_pipeline, pipeline_dcn=comm_pipeline_dcn))
         return
     qureg.put(swap_bit_blocks(qureg.amps, n=nsv, lo1=tile_bits - k,
                               lo2=tile_bits if hi is None else hi, k=k))
@@ -1970,11 +1986,13 @@ def as_tape(p: FusePlan) -> list:
                             (item.ops, item.tile_bits, item.load_swap_k,
                              item.store_swap_k, item.load_swap_hi,
                              item.store_swap_hi, item.ring_depth,
-                             item.comm_pipeline, item.seg), {}))
+                             item.comm_pipeline, item.seg,
+                             item.comm_pipeline_dcn), {}))
         elif isinstance(item, FrameSwap):
             entries.append((_apply_frame_swap,
                             (item.tile_bits, item.k, item.hi,
-                             item.comm_pipeline, item.seg), {}))
+                             item.comm_pipeline, item.seg,
+                             item.comm_pipeline_dcn), {}))
         else:
             entries.append(item)
     return entries

@@ -72,7 +72,8 @@ from .mesh import local_qubit_count
 __all__ = ["dist_apply_matrix1", "dist_apply_x", "dist_apply_diag_phase",
            "dist_apply_parity_phase", "dist_apply_local_matrix", "dist_swap",
            "dist_permute_bits", "permute_collective_stats",
-           "comm_pipeline_default", "resolve_pipeline",
+           "comm_pipeline_default", "comm_pipeline_dcn_default",
+           "resolve_pipeline", "resolve_pipeline_dcn",
            "effective_comm_pipeline"]
 
 
@@ -109,6 +110,43 @@ def comm_pipeline_default() -> int:
 def resolve_pipeline(pipeline) -> int:
     """Explicit ``pipeline=`` argument if given, else the env default."""
     return int(pipeline) if pipeline is not None else comm_pipeline_default()
+
+
+#: per-link-class override (round 15): collectives whose shard bits ride
+#: the slow cross-slice DCN link pipeline at this depth instead of the
+#: base QUEST_COMM_PIPELINE -- the latency gap between DCN and ICI means
+#: the overlap window a DCN sub-collective must fill is deeper. Unset
+#: inherits the base depth (the flat, single-tier behaviour).
+_PIPE_DCN_ENV = "QUEST_COMM_PIPELINE_DCN"
+
+_PIPE_DCN_ENV_WARNED: set = set()
+
+
+def comm_pipeline_dcn_default():
+    """The env-resolved DCN comm-pipeline depth, or None when
+    ``QUEST_COMM_PIPELINE_DCN`` is unset (inherit the base depth).
+    Malformed values warn once via QT210, mirroring the base knob's
+    QT206."""
+    import os
+    if not os.environ.get(_PIPE_DCN_ENV, "").strip():
+        return None
+    from ..analysis.diagnostics import parse_env_int
+    return parse_env_int(_PIPE_DCN_ENV, 1, minimum=1,
+                         code="QT210", noun="DCN pipeline depth",
+                         below="is below the monolithic minimum",
+                         warned=_PIPE_DCN_ENV_WARNED)
+
+
+def resolve_pipeline_dcn(pipeline_dcn, pipeline=None) -> int:
+    """Depth for a DCN-riding collective: the explicit ``pipeline_dcn``
+    argument, else the ``QUEST_COMM_PIPELINE_DCN`` env, else fall all the
+    way back to the base (ICI) resolution of ``pipeline``."""
+    if pipeline_dcn is not None:
+        return int(pipeline_dcn)
+    env = comm_pipeline_dcn_default()
+    if env is not None:
+        return env
+    return resolve_pipeline(pipeline)
 
 
 def effective_comm_pipeline(depth: int, limit: int, *,

@@ -29,6 +29,26 @@ def shard_info(n: int, mesh: Mesh | None):
     return nl, n - nl, AMP_AXIS
 
 
+def slice_chip_bits(mesh: Mesh | None, num_slices: int) -> int:
+    """Number of intra-slice (ICI) shard bits of a slice-major pod
+    topology: the device index's low bits address chips within a slice,
+    the top log2(num_slices) bits cross slices (DCN). Rejects a slice
+    count that does not evenly power-of-two-partition the mesh -- the
+    slice-major device order is only meaningful when every slice holds
+    the same power-of-two chip count."""
+    ns = max(int(num_slices), 1)
+    if ns & (ns - 1):
+        raise ValueError(
+            f"num_slices must be a power of two (got {ns}): slice-major "
+            f"device order splits the shard bits at a bit boundary")
+    size = 1 if mesh is None else mesh.size
+    if ns > size or size % ns:
+        raise ValueError(
+            f"num_slices={ns} does not partition the {size}-device mesh "
+            f"into equal power-of-two slices")
+    return ((size // ns) - 1).bit_length()
+
+
 def shard_bit_link(n: int, mesh: Mesh | None, num_slices: int,
                    qubit: int) -> str | None:
     """Which interconnect a comm op on sharded ``qubit`` rides: 'ici'
@@ -37,5 +57,5 @@ def shard_bit_link(n: int, mesh: Mesh | None, num_slices: int,
     nl = local_qubit_count(n, mesh)
     if qubit < nl:
         return None
-    chip_bits = ((mesh.size // max(num_slices, 1)) - 1).bit_length()
-    return "ici" if (qubit - nl) < chip_bits else "dcn"
+    return "ici" if (qubit - nl) < slice_chip_bits(mesh, num_slices) \
+        else "dcn"

@@ -53,6 +53,40 @@ def _cycle_swaps(occ, pos, n: int) -> list:
     return out
 
 
+def _cycle_swaps_hier(occ, weight, n: int) -> list:
+    """Topology-aware variant of :func:`_cycle_swaps`: each permutation
+    cycle is decomposed as a PATH closed at its heaviest-link position d
+    (ties: highest position), so d rides exactly ONE swap. The pivot
+    chain above fixes the lowest position first, which funnels every
+    remaining cycle element through later swaps -- a high (DCN) position
+    inside a k-cycle can be touched up to k-1 times. Here the walk
+    e0 = occ[d], e_{i+1} = occ[e_i] ends at e_{k-1} = d and the swaps
+    (e_i, e_{i+1}) are emitted for i = k-2 .. 0: interiors ride two
+    swaps, the endpoints (d and its successor) one -- the "each
+    DCN-crossing bit moves at most once per reconcile" invariant
+    check_schedule's QT108 verifies."""
+    occ = list(occ)
+    out = []
+    seen = [False] * n
+    for start in range(n):
+        if seen[start] or occ[start] == start:
+            seen[start] = True
+            continue
+        cyc = []
+        p = start
+        while not seen[p]:
+            seen[p] = True
+            cyc.append(p)
+            p = occ[p]
+        d = max(cyc, key=lambda q: (weight(q), q))
+        e = [occ[d]]
+        while e[-1] != d:
+            e.append(occ[e[-1]])
+        for i in range(len(e) - 2, -1, -1):
+            out.append((e[i], e[i + 1]))
+    return out
+
+
 def plane_unit_scale(amps) -> float:
     """Chunk-unit scale of a state layout relative to the planar f32 pair
     (8 bytes/amplitude): 1.0 for planar f32, 2.0 for BOTH double-precision
@@ -74,6 +108,17 @@ def _swap_price(a: int, b: int, nl: int) -> float:
     if max(a, b) < nl:
         return 0.0
     return 2.0 if min(a, b) >= nl else 1.0
+
+
+#: default relative price of a DCN chunk-unit against an ICI one for the
+#: HIERARCHICAL scheduling decisions (hierarchical=True). The published
+#: inter-slice figures put DCN an order of magnitude below ICI per link;
+#: 4x is the conservative planning ratio -- any weight > 2 already flips
+#: every decision this PR adds (relay staging beats a direct DCN rank
+#: permute when 2 + w < 2w). The weight NEVER enters the chunk-unit
+#: accounting itself: stats/telemetry stay in flat chunk-units per link,
+#: so flat and hierarchical plans are compared in one currency.
+DCN_COST_WEIGHT = 4.0
 
 
 @dataclass
@@ -102,6 +147,19 @@ class DistributedScheduler:
     mesh: Mesh
     #: pod-slice count for ICI-vs-DCN traffic classification (1 = all ICI)
     num_slices: int = 1
+    #: True makes the PLANNING topology-hierarchical (round 15): reconcile
+    #: swap chains path-decompose with DCN positions as endpoints (each
+    #: DCN bit rides at most one swap per reconciliation), a both-sharded
+    #: ICI<->DCN swap stages through an intra-slice local relay when the
+    #: two-tier model prices it cheaper, relocation batching orders DCN
+    #: sources onto the idlest eviction slots, and every chain-vs-
+    #: collective decision weighs DCN chunk-units by ``dcn_cost_weight``.
+    #: False (the default) is the flat single-tier scheduler, bit-
+    #: identical to the pre-round-15 behaviour -- the A/B baseline.
+    hierarchical: bool = False
+    #: relative DCN-vs-ICI chunk-unit price for hierarchical decisions
+    #: (never enters the accounting; see DCN_COST_WEIGHT)
+    dcn_cost_weight: float = DCN_COST_WEIGHT
     #: False forces the reference's immediate policy (begin_defer no-ops)
     allow_defer: bool = True
     #: False reverts reconciliation to the round-3/4 per-cycle swap chain
@@ -120,6 +178,13 @@ class DistributedScheduler:
     #: ``num_slices``: that splits the MESH into ICI/DCN slices, this
     #: splits each device's CHUNK into overlappable sub-transfers.
     comm_pipeline: int | None = None
+    #: per-link-class pipeline override (round 15): collectives that touch
+    #: a DCN shard bit launch at this depth instead of ``comm_pipeline``
+    #: (None = the QUEST_COMM_PIPELINE_DCN env, else inherit the base
+    #: depth). Like the base knob it only re-times traffic -- pricing and
+    #: every scheduling decision are depth-invariant -- and it is inert at
+    #: num_slices=1 (no DCN bits exist to classify).
+    comm_pipeline_dcn: int | None = None
     stats: dict = field(default_factory=lambda: {
         "pair_exchanges": 0, "relocation_swaps": 0, "rank_permutes": 0,
         "comm_free": 0, "local": 0, "channel_superops": 0,
@@ -132,7 +197,13 @@ class DistributedScheduler:
         "frame_transpose_collectives": 0,
         "frame_transpose_chunks": 0.0,
         "frame_transpose_planar_chunks": 0.0,
-        "ici_chunks": 0.0, "dcn_chunks": 0.0})
+        "staged_relays": 0,
+        "ici_chunks": 0.0, "dcn_chunks": 0.0,
+        # two-tier model detail: chunk-units per "kind/link" pair, the
+        # exact per-cell figures the telemetry series
+        # comm_chunk_units_total{kind,link} must sum to (and
+        # check_schedule re-derives from the journal)
+        "chunks_by_kind_link": {}})
     #: optional decision journal for the static plan verifier
     #: (analysis.plancheck.check_schedule): when set to a list, every
     #: communication decision appends one record --
@@ -143,10 +214,15 @@ class DistributedScheduler:
     #:   | ("reconcile_done", n)
     #:   | ("segment", lo)   -- zero-cost marker: a sliced segment-program
     #:     replay opened a defer span at tape cursor ``lo`` (round 13)
+    #:   | ("staged_relay", n, a, b, r)  -- zero-cost marker: the three
+    #:     reconcile_swap records that follow relay the a<->b exchange
+    #:     through local position r (round 15, hierarchical only)
     #: plus one leading ("comm_pipeline", depth) stamp recording the
     #: resolved pipeline depth the plan's collectives launch at (priced at
     #: ZERO chunk-units by check_schedule: the proof that pipelining
-    #: leaves the model unchanged)
+    #: leaves the model unchanged). A multi-slice plan (num_slices > 1)
+    #: stamps ("comm_pipeline", depth, dcn_depth) instead -- the per-link-
+    #: class depths; single-slice journals keep the historical 2-tuple.
     #: -- enough to re-price the whole plan and replay the layout
     #: independently. None (the default) records nothing.
     journal: list | None = None
@@ -156,8 +232,14 @@ class DistributedScheduler:
             if not self.journal:
                 # stamped lazily at the first record: plan_circuit attaches
                 # the journal list after construction
-                self.journal.append(
-                    ("comm_pipeline", X.resolve_pipeline(self.comm_pipeline)))
+                base = X.resolve_pipeline(self.comm_pipeline)
+                if self.num_slices > 1:
+                    self.journal.append(
+                        ("comm_pipeline", base,
+                         X.resolve_pipeline_dcn(self.comm_pipeline_dcn,
+                                                self.comm_pipeline)))
+                else:
+                    self.journal.append(("comm_pipeline", base))
             self.journal.append(rec)
 
     def _count_comm(self, n: int, qubit: int, chunks: float,
@@ -174,8 +256,87 @@ class DistributedScheduler:
         link = shard_bit_link(n, self.mesh, self.num_slices, qubit)
         if link is not None:
             self.stats[f"{link}_chunks"] += chunks
+        cell = f"{kind}/{link or 'local'}"
+        by = self.stats["chunks_by_kind_link"]
+        by[cell] = by.get(cell, 0.0) + chunks
         telemetry.inc("comm_chunk_units_total", chunks, kind=kind,
                       link=link or "local")
+
+    def _link_weight(self, n: int, qubit: int) -> float:
+        """Decision weight of one chunk-unit attributed to physical
+        ``qubit``: ``dcn_cost_weight`` on the DCN bits, 1 on ICI, 0 local.
+        Only the hierarchical planner consults it."""
+        from .mesh import shard_bit_link
+
+        link = shard_bit_link(n, self.mesh, self.num_slices, qubit)
+        if link is None:
+            return 0.0
+        return self.dcn_cost_weight if link == "dcn" else 1.0
+
+    def _is_dcn(self, n: int, qubit: int) -> bool:
+        from .mesh import shard_bit_link
+
+        return shard_bit_link(n, self.mesh, self.num_slices,
+                              qubit) == "dcn"
+
+    def _pipeline_for(self, n: int, positions, pipeline=None,
+                      pipeline_dcn=None):
+        """Launch depth for a collective touching the sharded physical
+        ``positions``: the per-link-class resolution (round 15) hands a
+        DCN-riding collective the DCN depth (explicit argument, then the
+        scheduler's ``comm_pipeline_dcn``, then QUEST_COMM_PIPELINE_DCN,
+        then fall back to the base); everything else -- and every launch
+        on a single-slice mesh -- keeps the base depth unchanged."""
+        base = pipeline if pipeline is not None else self.comm_pipeline
+        if self.num_slices <= 1:
+            return base
+        if not any(self._is_dcn(n, p) for p in positions):
+            return base
+        return X.resolve_pipeline_dcn(
+            pipeline_dcn if pipeline_dcn is not None
+            else self.comm_pipeline_dcn, base)
+
+    def _weighted_permute_units(self, n: int, nl: int, source,
+                                cstats) -> float:
+        """The grouped-permute collective's cost under the two-tier model:
+        the same even-split attribution as the accounting, each bit's
+        share scaled by its link weight."""
+        total = 0.0
+        cross = [q for q in range(nl, n) if source[q] < nl]
+        if cross:
+            share = 2.0 * (1.0 - 0.5 ** len(cross)) / len(cross)
+            total += sum(share * self._link_weight(n, q) for q in cross)
+        if cstats["relabel_ppermute"]:
+            moved = [q for q in range(nl, n)
+                     if source[q] >= nl and source[q] != q]
+            total += sum(2.0 * self._link_weight(n, q) / len(moved)
+                         for q in moved)
+        return total
+
+    def _chain_plan(self, swaps, n: int, nl: int):
+        """Execution plan for a hierarchical reconcile swap chain:
+        ('swap', a, b) steps, with a both-sharded ICI<->DCN swap replaced
+        by a ('relay', a, b, r) staging triple -- swap(b,r); swap(a,r);
+        swap(b,r) through local r, which composes to swap(a,b), leaves r
+        untouched, and rides the DCN link ONCE at 1 unit instead of the
+        direct rank permute's 2 -- whenever the two-tier model prices
+        2 + w below 2w. Returns (plan, flat_units, weighted_units)."""
+        plan, units, weighted = [], 0.0, 0.0
+        for a, b in swaps:
+            price = _swap_price(a, b, nl)
+            wmax = self._link_weight(n, max(a, b))
+            if (price == 2.0 and nl > 0
+                    and self._is_dcn(n, max(a, b))
+                    and not self._is_dcn(n, min(a, b))
+                    and 2.0 + self.dcn_cost_weight < 2.0 * wmax):
+                plan.append(("relay", a, b, 0))
+                units += 3.0
+                weighted += 2.0 + self.dcn_cost_weight
+            else:
+                plan.append(("swap", a, b))
+                units += price
+                weighted += price * wmax
+        return plan, units, weighted
 
     def __post_init__(self):
         self.deferring = False
@@ -314,7 +475,16 @@ class DistributedScheduler:
         chain). ``collective_reconcile=False`` forces the swap chain for
         A/B plan stats. Both paths account their traffic in
         ``reconcile_chunks`` with the same per-swap prices as
-        :meth:`apply_swap` (1 unit mixed, 2 units both-sharded)."""
+        :meth:`apply_swap` (1 unit mixed, 2 units both-sharded).
+
+        Hierarchical mode (round 15): the chain comes from
+        :func:`_cycle_swaps_hier` (every DCN position an endpoint of its
+        cycle's path decomposition -- at most one DCN swap per bit per
+        reconciliation), a both-sharded ICI<->DCN swap stages through a
+        local relay when 2 + w < 2w under the ``dcn_cost_weight`` w, and
+        the chain-vs-collective choice compares the TWO-TIER weighted
+        prices instead of the flat units. The accounting itself stays in
+        flat chunk-units either way."""
         if self._pos is None:
             return amps
         self._ensure_perm(n)
@@ -329,21 +499,41 @@ class DistributedScheduler:
         self.stats["reconcile_swap_equiv_chunks"] += swap_units
         source = tuple(self._pos)  # new bit q <- old bit pos[q]
         cstats = X.permute_collective_stats(n, source, self.mesh)
-        if not self.collective_reconcile or \
-                swap_units < cstats["chunk_units"]:
-            for a, b in swaps:
-                price = _swap_price(a, b, nl)
-                if price:
-                    self.stats["reconcile_swaps"] += 1
-                    self.stats["reconcile_chunks"] += price
-                    self._count_comm(n, max(a, b), price,
-                                     kind="reconciliation")
+        if self.hierarchical:
+            plan, _chain_units, chain_w = self._chain_plan(
+                _cycle_swaps_hier(self._occ,
+                                  lambda q: self._link_weight(n, q), n),
+                n, nl)
+            use_chain = not self.collective_reconcile or \
+                chain_w < self._weighted_permute_units(n, nl, source,
+                                                       cstats)
+        else:
+            plan = [("swap", a, b) for a, b in swaps]
+            use_chain = not self.collective_reconcile or \
+                swap_units < cstats["chunk_units"]
+        if use_chain:
+            for step in plan:
+                if step[0] == "relay":
+                    _, a, b, r = step
+                    self.stats["staged_relays"] += 1
+                    self._note("staged_relay", n, a, b, r)
+                    chain = ((b, r), (a, r), (b, r))
                 else:
-                    self.stats["local"] += 1
-                self._note("reconcile_swap", n, a, b)
-                amps = X.dist_swap(amps, n=n, qb1=a, qb2=b, mesh=self.mesh,
-                                    pipeline=self.comm_pipeline)
-                self._swap_positions(a, b)
+                    chain = (step[1:],)
+                for x, y in chain:
+                    price = _swap_price(x, y, nl)
+                    if price:
+                        self.stats["reconcile_swaps"] += 1
+                        self.stats["reconcile_chunks"] += price
+                        self._count_comm(n, max(x, y), price,
+                                         kind="reconciliation")
+                    else:
+                        self.stats["local"] += 1
+                    self._note("reconcile_swap", n, x, y)
+                    amps = X.dist_swap(
+                        amps, n=n, qb1=x, qb2=y, mesh=self.mesh,
+                        pipeline=self._pipeline_for(n, (x, y)))
+                    self._swap_positions(x, y)
             self._note("reconcile_done", n)
             return amps
         self.stats["reconcile_collectives"] += cstats["collectives"]
@@ -366,14 +556,16 @@ class DistributedScheduler:
                 self._count_comm(n, q, 2.0 / len(moved),
                                  kind="reconciliation")
         self._note("permute", n, source, 1.0, "reconciliation")
+        touched = [q for q in range(nl, n) if source[q] != q]
         amps = X.dist_permute_bits(amps, n=n, source=source, mesh=self.mesh,
-                                   pipeline=self.comm_pipeline)
+                                   pipeline=self._pipeline_for(n, touched))
         self._pos = list(range(n))
         self._occ = list(range(n))
         self._note("reconcile_done", n)
         return amps
 
-    def apply_frame_permute(self, amps, *, n, lo1, lo2, k, pipeline=None):
+    def apply_frame_permute(self, amps, *, n, lo1, lo2, k, pipeline=None,
+                            pipeline_dcn=None):
         """One pallas frame transpose -- the bit-block swap
         [lo1, lo1+k) <-> [lo2, lo2+k) -- executed as the COUNTED grouped
         permute collective (exchange.dist_permute_bits) instead of an
@@ -410,9 +602,11 @@ class DistributedScheduler:
                 self._count_comm(n, q, 2.0 * scale / len(moved),
                                  kind="frame_transpose")
         self._note("permute", n, source, scale, "frame_transpose")
+        touched = [q for q in range(nl, n) if source[q] != q]
         return X.dist_permute_bits(
             amps, n=n, source=source, mesh=self.mesh,
-            pipeline=pipeline if pipeline is not None else self.comm_pipeline)
+            pipeline=self._pipeline_for(n, touched, pipeline,
+                                        pipeline_dcn))
 
     def _pending_shard_uses(self, n, nl, exclude, capacity) -> list:
         """Sharded physical positions that tape entries between the cursor
@@ -503,6 +697,29 @@ class DistributedScheduler:
                 # no lookahead (eager deferral): least-recently-used,
                 # preferring high slots on ties (low qubits run hot)
                 free.sort(key=lambda p: (self._last_use[self._occ[p]], -p))
+            if self.hierarchical:
+                # two-tier slot assignment (round 15): DCN sources first,
+                # and each one claims the free slot whose occupant has the
+                # FARTHEST next dense use over the whole lookahead -- the
+                # qubit parked on the DCN bit is the one that keeps it
+                # quiet longest (the flat sort ranks by any-next-use,
+                # which diagonal-only traffic inflates for nothing)
+                shard = sorted(shard,
+                               key=lambda p: -self._link_weight(n, p))
+                dcn_src = [p for p in shard if self._is_dcn(n, p)]
+                if dcn_src:
+                    idle, pool = [], list(free)
+                    for s in dcn_src:
+                        # on a next-dense tie (typically the 1<<30 "never"
+                        # sentinel) send the bit HOME: parking logical s at
+                        # physical s means the closing reconcile finds the
+                        # DCN bit already in place and never crosses DCN
+                        best = max(pool, key=lambda p: (
+                            self._next_dense_use(self._occ[p]),
+                            self._occ[p] == s))
+                        pool.remove(best)
+                        idle.append(best)
+                    free = idle + pool
         batch = list(shard)
         slots = free[:len(shard)]
         if self.deferring and self.batch_relocations:
@@ -520,12 +737,43 @@ class DistributedScheduler:
             # first, so the first failed admission ends the matching.
             tail = free[len(shard):]
             tail.sort(key=lambda p: -self._next_dense_use(self._occ[p]))
-            for p, first_use in self._pending_shard_uses(
-                    n, nl, set(batch) | set(support_phys), len(tail)):
+            cands = self._pending_shard_uses(
+                n, nl, set(batch) | set(support_phys), len(tail))
+            if self.hierarchical:
+                # DCN-avoiding admission (round 15): a DCN position is
+                # NEVER prefetched -- an early pull shortens its
+                # occupant's residency and adds a whole extra DCN epoch
+                # over the defer window (each relocation of the DCN bit
+                # parks a fresh dense-usable qubit there; moving it as
+                # late as possible minimises how many cycle through).
+                # When its dense use finally arrives the relocation is
+                # FORCED and rides that gate's batch at the grouped
+                # all-to-all's even-split share. The surviving (ICI)
+                # candidates keep the weighted order, and a candidate
+                # that loses its Belady test no longer ends the matching
+                # (the reorder breaks the soonest-first monotonicity
+                # that made the early exit sound).
+                cands = [pf for pf in cands if not self._is_dcn(n, pf[0])]
+                cands.sort(key=lambda pf: (-self._link_weight(n, pf[0]),
+                                           pf[1]))
+            dcn_batch = self.hierarchical and \
+                any(self._is_dcn(n, p) for p in shard)
+            for p, first_use in cands:
                 si = len(batch) - len(shard)
-                if si >= len(tail) or \
-                        first_use >= self._next_dense_use(
-                            self._occ[tail[si]]):
+                if si >= len(tail):
+                    break
+                if first_use >= self._next_dense_use(self._occ[tail[si]]):
+                    if self.hierarchical:
+                        if dcn_batch:
+                            # fatten the DCN-bearing all-to-all: each
+                            # extra crossing costs 2^-m marginally but
+                            # shrinks the DCN bit's even-split share from
+                            # u_m/m to u_{m+1}/(m+1) -- under the w-fold
+                            # DCN weight that dominates the churn risk of
+                            # an unsound (early-next-use) eviction, which
+                            # lands on an ICI position either way
+                            batch.append(p)
+                        continue
                     break
                 batch.append(p)
             slots = slots + tail[:len(batch) - len(shard)]
@@ -537,7 +785,17 @@ class DistributedScheduler:
             for s, f in pairs:
                 source[s], source[f] = source[f], source[s]
             cstats = X.permute_collective_stats(n, tuple(source), self.mesh)
-            if cstats["chunk_units"] < swap_units:
+            if self.hierarchical:
+                # two-tier comparison: the batched all-to-all crosses the
+                # DCN bit once at its even-split share, each singleton
+                # swap at a full unit -- weigh both sides per link
+                win = self._weighted_permute_units(
+                    n, nl, source, cstats) < sum(
+                        _swap_price(f, s, nl) * self._link_weight(
+                            n, max(f, s)) for s, f in pairs)
+            else:
+                win = cstats["chunk_units"] < swap_units
+            if win:
                 self.stats["relocation_batches"] += 1
                 self.stats["relocation_batch_qubits"] += len(pairs)
                 self.stats["relocation_prefetched"] += len(batch) - len(shard)
@@ -552,9 +810,10 @@ class DistributedScheduler:
                     self._count_comm(n, s, share, kind="relocation_batch")
                 self._note("permute", n, tuple(source), 1.0,
                            "relocation_batch")
-                amps = X.dist_permute_bits(amps, n=n, source=tuple(source),
-                                           mesh=self.mesh,
-                                           pipeline=self.comm_pipeline)
+                amps = X.dist_permute_bits(
+                    amps, n=n, source=tuple(source), mesh=self.mesh,
+                    pipeline=self._pipeline_for(
+                        n, [s for s, _ in pairs]))
                 for s, f in pairs:
                     self._swap_positions(f, s)
                 return amps, {s: f for s, f in pairs if s in set(shard)}
@@ -564,7 +823,7 @@ class DistributedScheduler:
             self._count_comm(n, s, 1.0, kind="dist_swap")
             self._note("dist_swap", n, f, s, self.deferring)
             amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh,
-                               pipeline=self.comm_pipeline)
+                               pipeline=self._pipeline_for(n, (s,)))
             if self.deferring:
                 self._swap_positions(f, s)
             relocation[s] = f
@@ -605,7 +864,8 @@ class DistributedScheduler:
                     amps, matrix, n=n, target=p_targets[0],
                     controls=p_controls,
                     control_states=tuple(control_states), conj=conj,
-                    mesh=self.mesh, pipeline=self.comm_pipeline)
+                    mesh=self.mesh,
+                    pipeline=self._pipeline_for(n, (p_targets[0],)))
             self.stats["local"] += 1
             return X.dist_apply_local_matrix(
                 amps, matrix, n=n,
@@ -630,7 +890,7 @@ class DistributedScheduler:
                 self._count_comm(n, s, 1.0, kind="dist_swap")
                 self._note("dist_swap", n, f, s, False)
                 amps = X.dist_swap(amps, n=n, qb1=f, qb2=s, mesh=self.mesh,
-                                   pipeline=self.comm_pipeline)
+                                   pipeline=self._pipeline_for(n, (s,)))
         return amps
 
     # -- permutation class --------------------------------------------------
@@ -669,7 +929,9 @@ class DistributedScheduler:
         return X.dist_apply_x(amps, n=n, targets=p_targets,
                               controls=p_controls,
                               control_states=tuple(control_states),
-                              mesh=self.mesh, pipeline=self.comm_pipeline)
+                              mesh=self.mesh,
+                              pipeline=self._pipeline_for(
+                                  n, [t for t in p_targets if t >= nl]))
 
     def apply_swap(self, amps, *, n, qb1, qb2):
         self._touch((qb1, qb2))
@@ -690,6 +952,27 @@ class DistributedScheduler:
         if both_local:
             self.stats["local"] += 1
         elif min(p1, p2) >= nl:
+            a, b = max(p1, p2), min(p1, p2)
+            if (self.hierarchical and nl > 0 and self._is_dcn(n, a)
+                    and not self._is_dcn(n, b)
+                    and 2.0 + self.dcn_cost_weight
+                        < 2.0 * self.dcn_cost_weight):
+                # stage the cross-slice exchange through a local relay:
+                # three odd-parity half-exchanges (1 unit each, one on
+                # DCN) instead of a full-chunk rank permute (2 units, all
+                # on DCN) -- the immediate-mode twin of the reconcile
+                # chain's ('relay', a, b, r) step
+                r = 0
+                self.stats["staged_relays"] += 1
+                self._note("staged_relay", n, a, b, r)
+                for x, y in ((b, r), (a, r), (b, r)):
+                    self.stats["relocation_swaps"] += 1
+                    self._count_comm(n, max(x, y), 1.0, kind="dist_swap")
+                    self._note("dist_swap", n, y, x, False)
+                    amps = X.dist_swap(
+                        amps, n=n, qb1=x, qb2=y, mesh=self.mesh,
+                        pipeline=self._pipeline_for(n, (x, y)))
+                return amps
             self.stats["rank_permutes"] += 1
             self._count_comm(n, max(p1, p2), 2.0, kind="grouped_permute")
             self._note("rank_permute", n, max(p1, p2))
@@ -698,7 +981,7 @@ class DistributedScheduler:
             self._count_comm(n, max(p1, p2), 1.0, kind="dist_swap")
             self._note("dist_swap", n, p1, p2, False)
         return X.dist_swap(amps, n=n, qb1=p1, qb2=p2, mesh=self.mesh,
-                           pipeline=self.comm_pipeline)
+                           pipeline=self._pipeline_for(n, (p1, p2)))
 
     # -- diagonal family (always comm-free) ---------------------------------
 
@@ -738,7 +1021,9 @@ class DistributedScheduler:
 def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True,
                   collective_reconcile: bool = True,
                   batch_relocations: bool = True,
-                  comm_pipeline: int | None = None):
+                  comm_pipeline: int | None = None,
+                  hierarchical: bool = False,
+                  comm_pipeline_dcn: int | None = None):
     """Route L5 gate application through the explicit shard_map kernels.
     ``num_slices`` > 1 splits the plan's comm stats into ICI vs DCN chunks
     (slice-major device order; parallel.mesh.shard_bit_link).
@@ -746,7 +1031,11 @@ def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True,
     (A/B against the round-6 grouped-permute batching).
     ``comm_pipeline`` sets the collective pipeline depth every exchange
     launch in the context runs at (None = the QUEST_COMM_PIPELINE env
-    default, 1 = monolithic; bit-identical at every depth)."""
+    default, 1 = monolithic; bit-identical at every depth);
+    ``comm_pipeline_dcn`` overrides it for DCN-riding collectives (None =
+    the QUEST_COMM_PIPELINE_DCN env, else inherit). ``hierarchical=True``
+    turns on the two-tier DCN-aware planning decisions (round 15;
+    False keeps the flat scheduler, the A/B baseline)."""
     from ..environment import AMP_AXIS
     if mesh is not None and mesh.size > 1 and AMP_AXIS not in mesh.shape:
         raise ValueError(
@@ -757,7 +1046,9 @@ def explicit_mesh(mesh: Mesh, num_slices: int = 1, defer: bool = True,
                                   allow_defer=defer,
                                   collective_reconcile=collective_reconcile,
                                   batch_relocations=batch_relocations,
-                                  comm_pipeline=comm_pipeline)
+                                  comm_pipeline=comm_pipeline,
+                                  hierarchical=hierarchical,
+                                  comm_pipeline_dcn=comm_pipeline_dcn)
              if mesh is not None and mesh.size > 1 else None)
     prev = getattr(_STATE, "sched", None)
     _STATE.sched = sched
@@ -792,7 +1083,9 @@ def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
                  defer: bool = True, collective_reconcile: bool = True,
                  batch_relocations: bool = True, dtype=None,
                  journal: list | None = None,
-                 comm_pipeline: int | None = None):
+                 comm_pipeline: int | None = None,
+                 hierarchical: bool = False,
+                 comm_pipeline_dcn: int | None = None):
     """Trace ``circuit`` abstractly under the explicit scheduler and return
     its communication plan stats (no device execution -- jax.eval_shape).
     ``dtype`` sets the abstract register's amplitude dtype (default: the
@@ -803,7 +1096,10 @@ def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
     for the static verifier (see DistributedScheduler.journal);
     ``comm_pipeline`` stamps the resolved collective pipeline depth into
     that journal (pricing is depth-invariant -- check_schedule proves
-    it)."""
+    it); at num_slices > 1 the stamp widens to (base, dcn) per-link-class
+    depths, ``comm_pipeline_dcn`` overriding the DCN one.
+    ``hierarchical=True`` plans with the two-tier DCN-aware decisions
+    (see explicit_mesh)."""
     import jax
     import numpy as np
 
@@ -819,7 +1115,9 @@ def plan_circuit(circuit, mesh: Mesh, num_slices: int = 1,
     with explicit_mesh(mesh, num_slices=num_slices, defer=defer,
                        collective_reconcile=collective_reconcile,
                        batch_relocations=batch_relocations,
-                       comm_pipeline=comm_pipeline) as sched:
+                       comm_pipeline=comm_pipeline,
+                       hierarchical=hierarchical,
+                       comm_pipeline_dcn=comm_pipeline_dcn) as sched:
         if sched is not None and journal is not None:
             sched.journal = journal
         fn = circuit.as_fn()
