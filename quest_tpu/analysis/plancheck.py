@@ -429,6 +429,26 @@ def check_schedule(journal: list, stats: dict, n: int, mesh, *,
             findings.append(make_finding(
                 "QT103", f"unknown journal record kind {kind!r}", where))
 
+    # a journal that ends mid-reconciliation (truncated or malformed)
+    # must not silently discard the accumulated DCN touch counts: flag
+    # the unterminated chain and run the same QT108 emission over the
+    # leftovers that reconcile_done would have
+    if recon_dcn_touch:
+        findings.append(make_finding(
+            "QT103",
+            f"journal ends inside a reconciliation chain (DCN shard "
+            f"bits {sorted(recon_dcn_touch)} touched with no "
+            f"terminating reconcile_done record)", f"{location}.end"))
+        for q, cnt in sorted(recon_dcn_touch.items()):
+            if cnt > 1:
+                findings.append(make_finding(
+                    "QT108",
+                    f"DCN shard bit {q} moved {cnt} times inside one "
+                    f"reconciliation chain: the cycle decomposition "
+                    f"crossed the inter-slice link redundantly "
+                    f"(hierarchical=True path-decomposes each cycle "
+                    f"to touch the DCN bit once)", f"{location}.end"))
+
     for key in ("pair_exchanges", "rank_permutes", "relocation_swaps",
                 "virtual_swaps"):
         if totals[key] != stats.get(key, 0):

@@ -316,11 +316,14 @@ class DistributedScheduler:
     def _chain_plan(self, swaps, n: int, nl: int):
         """Execution plan for a hierarchical reconcile swap chain:
         ('swap', a, b) steps, with a both-sharded ICI<->DCN swap replaced
-        by a ('relay', a, b, r) staging triple -- swap(b,r); swap(a,r);
-        swap(b,r) through local r, which composes to swap(a,b), leaves r
-        untouched, and rides the DCN link ONCE at 1 unit instead of the
-        direct rank permute's 2 -- whenever the two-tier model prices
-        2 + w below 2w. Returns (plan, flat_units, weighted_units)."""
+        by a ('relay', d, o, r) staging triple -- d ALWAYS the DCN
+        position, o the ICI one (apply_swap's immediate-mode convention;
+        _cycle_swaps_hier emits the DCN endpoint in either tuple slot) --
+        executed as swap(o,r); swap(d,r); swap(o,r) through local r,
+        which composes to swap(d,o), leaves r untouched, and rides the
+        DCN link ONCE at 1 unit instead of the direct rank permute's 2
+        -- whenever the two-tier model prices 2 + w below 2w. Returns
+        (plan, flat_units, weighted_units)."""
         plan, units, weighted = [], 0.0, 0.0
         for a, b in swaps:
             price = _swap_price(a, b, nl)
@@ -329,7 +332,7 @@ class DistributedScheduler:
                     and self._is_dcn(n, max(a, b))
                     and not self._is_dcn(n, min(a, b))
                     and 2.0 + self.dcn_cost_weight < 2.0 * wmax):
-                plan.append(("relay", a, b, 0))
+                plan.append(("relay", max(a, b), min(a, b), 0))
                 units += 3.0
                 weighted += 2.0 + self.dcn_cost_weight
             else:
@@ -515,9 +518,15 @@ class DistributedScheduler:
             for step in plan:
                 if step[0] == "relay":
                     _, a, b, r = step
+                    # the DCN position must ride ONLY the middle swap --
+                    # the outer pair touches the relay twice, so putting
+                    # the DCN bit there pays the slow link twice and
+                    # breaks the QT108 once-per-reconcile invariant
+                    h = a if self._is_dcn(n, a) else b
+                    o = b if h == a else a
                     self.stats["staged_relays"] += 1
                     self._note("staged_relay", n, a, b, r)
-                    chain = ((b, r), (a, r), (b, r))
+                    chain = ((o, r), (h, r), (o, r))
                 else:
                     chain = (step[1:],)
                 for x, y in chain:

@@ -163,6 +163,46 @@ def test_flat_swap_chain_trips_qt108_hierarchical_does_not():
     assert not codes[True], codes[True]
 
 
+def test_deferred_cross_slice_swap_relays_once_on_dcn():
+    # regression (round-15 review): a deferred swapGate(17,19) -- both
+    # positions sharded, 19 the DCN bit -- reconciles through the staged
+    # ICI relay. The DCN position must ride ONLY the middle swap of the
+    # (o,r);(h,r);(o,r) chain: the executor once put it on the outer
+    # pair, paying the slow link twice and tripping its own QT108
+    c = Circuit(20)
+    c.swapGate(17, 19)
+    journal: list = []
+    stats = plan_circuit(c, MESH8, num_slices=2, hierarchical=True,
+                         collective_reconcile=False, journal=journal)
+    assert stats["staged_relays"] == 1
+    # 1 DCN + 2 ICI chunk-units -- exactly what _chain_plan priced
+    assert stats["chunks_by_kind_link"]["reconciliation/dcn"] == \
+        pytest.approx(1.0)
+    assert stats["chunks_by_kind_link"]["reconciliation/ici"] == \
+        pytest.approx(2.0)
+    swaps = [r for r in journal if r[0] == "reconcile_swap"]
+    assert [max(a, b) for _, _, a, b in swaps] == [17, 19, 17]
+    findings = check_schedule(journal, stats, 20, MESH8, num_slices=2)
+    assert not [f for f in findings if f.code == "QT108"], findings
+    assert not [f for f in findings if f.severity == "error"], findings
+
+
+def test_truncated_reconcile_chain_is_flagged():
+    # a journal that ends mid-reconciliation must not silently discard
+    # the accumulated DCN touch counts: the unterminated chain is QT103
+    # and the leftovers still get reconcile_done's QT108 emission
+    journal = [("comm_pipeline", 1, 1),
+               ("reconcile_swap", 20, 19, 0),
+               ("reconcile_swap", 20, 19, 0)]
+    stats = {"reconcile_chunks": 2.0,
+             "chunks_by_kind_link": {"reconciliation/dcn": 2.0}}
+    findings = check_schedule(journal, stats, 20, MESH8, num_slices=2)
+    assert any(f.code == "QT103" and "reconciliation chain" in f.message
+               for f in findings)
+    assert any(f.code == "QT108" and "moved 2 times" in f.message
+               for f in findings)
+
+
 def test_malformed_staged_relay_record_is_flagged():
     # a relay that stages through a SHARDED slot (or around a non-DCN
     # swap) defeats its purpose; check_schedule rejects the record
