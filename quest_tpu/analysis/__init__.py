@@ -1,7 +1,8 @@
 """Static-analysis subsystem: prove schedule invariants before execution.
 
-Three checkers over one diagnostics framework (:mod:`.diagnostics`;
-codes ``QT0xx`` lint / ``QT1xx`` plan / ``QT2xx`` kernel):
+Five checkers over one diagnostics framework (:mod:`.diagnostics`;
+codes ``QT0xx`` lint / ``QT1xx`` plan / ``QT2xx`` kernel / ``QT6xx``
+concurrency):
 
 - :mod:`.plancheck` -- symbolic FusePlan frame replay and scheduler
   journal re-pricing (the model-vs-plan gate),
@@ -9,7 +10,14 @@ codes ``QT0xx`` lint / ``QT1xx`` plan / ``QT2xx`` kernel):
 - :mod:`.commcheck` -- abstract comm-pipeline (pipelined collective)
   transfer/compute hazard proofs,
 - :mod:`.tapelint` -- GateEvent tape lints (cancellations, mergeable
-  rotations, param-lift candidates, apply-time traps).
+  rotations, param-lift candidates, apply-time traps),
+- :mod:`.concheck` -- the concurrency verifier for the serving fleet:
+  QT601 lock-order deadlock-cycle analysis over the runtime
+  held-while-acquiring graph, the deterministic
+  :class:`~.concheck.InterleavingExplorer` (schedule-complete racing of
+  submit/close, quarantine-failover, and hedged dispatch), and the
+  QT603/QT604 atomicity + raw-lock AST lints
+  (``tools/lint.py --concurrency``).
 
 Reachable three ways: the ``tools/lint.py`` CLI, the pytest suites, and
 ``QUEST_VERIFY=1`` runtime gating -- :func:`verify_plan` runs at
@@ -28,6 +36,10 @@ from .diagnostics import (CATALOG, SEVERITIES, AnalysisError, Finding,
                           render_json, render_text, summarize)
 from .commcheck import (check_comm_pipeline, check_pipeline_events,
                         pipeline_events, sweep_comm_pipeline)
+from .concheck import (SCENARIOS, CountingFuture, ExplorationResult,
+                       InterleavingExplorer, await_future, check_atomicity,
+                       check_lock_order, check_raw_locks, lint_concurrency,
+                       run_scenario)
 from .plancheck import (check_circuit_comm, check_plan, check_schedule,
                         check_tape)
 from .ringcheck import check_events, check_ring, ring_events, sweep_reachable
@@ -42,6 +54,9 @@ __all__ = [
     "pipeline_events", "check_pipeline_events", "check_comm_pipeline",
     "sweep_comm_pipeline",
     "lint_events", "lint_tape", "lint_circuit",
+    "check_lock_order", "InterleavingExplorer", "ExplorationResult",
+    "await_future", "CountingFuture", "SCENARIOS", "run_scenario",
+    "lint_concurrency", "check_raw_locks", "check_atomicity",
     "verify_enabled", "verify_plan", "check_smoke_spec",
 ]
 

@@ -13,7 +13,12 @@ reports :class:`Finding` records drawn from one code catalog:
   generations -- docs/resilience.md),
 - ``QT4xx`` -- online integrity sentinels and the self-healing loop
   (norm/trace drift, per-shard checksum divergence, watchdog deadlines
-  -- :mod:`quest_tpu.resilience.sentinel`, docs/resilience.md).
+  -- :mod:`quest_tpu.resilience.sentinel`, docs/resilience.md),
+- ``QT6xx`` -- concurrency verification of the serving fleet (lock-order
+  deadlock cycles, locks held across blocking boundaries / future
+  resolution, atomicity and raw-lock lints --
+  :mod:`quest_tpu.analysis.concheck` over
+  :mod:`quest_tpu.resilience.sync`, docs/analysis.md).
 
 Each finding carries a severity (``error`` | ``warning`` | ``info``), a
 human-readable location and a one-line fix hint. :func:`emit_findings`
@@ -208,6 +213,40 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "channel; renormalise the operator set (non-TP maps have "
               "no unraveling -- keep them on the density route via "
               "mixNonTP*)"),
+    # -- QT6xx: concurrency verifier (analysis/concheck.py) -----------------
+    "QT600": ("error", "concurrency lint could not parse module",
+              "the file fed to tools/lint.py --concurrency has a syntax "
+              "error; fix the module (or exclude it from the scanned "
+              "paths) so the QT603/QT604 AST passes can run"),
+    "QT601": ("error", "lock-order cycle: potential deadlock",
+              "two threads acquire the named locks in opposite orders; "
+              "break the cycle by imposing one total order (the pool "
+              "lock orders BEFORE any engine lock) or by dropping one "
+              "lock before taking the other -- the finding carries the "
+              "first-occurrence acquisition stack of each edge"),
+    "QT602": ("error", "lock held across a blocking boundary",
+              "release every instrumented lock before device dispatch, "
+              "Future resolution/result(), thread join, or a condition "
+              "wait on a different lock: the blocked-on work may need "
+              "the held lock (the round-13 resolve-inside-close "
+              "deadlock class)"),
+    "QT603": ("warning", "field of a lock-owning class mutated both with "
+                         "and without its lock held",
+              "guard every mutation of the named attribute with the "
+              "class's lock (or rename it to mark single-threaded "
+              "ownership); mixed locked/unlocked writes are how atomic "
+              "invariants silently rot"),
+    "QT604": ("error", "raw threading lock constructed in instrumented "
+                       "serving code",
+              "construct quest_tpu.resilience.sync.Lock/RLock/Condition "
+              "(named) instead of threading.* so the lock participates "
+              "in the order graph, metrics, and the interleaving "
+              "explorer; append '# concheck: allow-raw-lock' with a "
+              "reason for deliberate exceptions"),
+    "QT605": ("warning", "QUEST_CONCHECK is malformed or out of range",
+              "set QUEST_CONCHECK to 0 (off, the default) or an integer "
+              ">= 1 to enable the instrumented sync layer; the "
+              "malformed value was replaced"),
 }
 
 

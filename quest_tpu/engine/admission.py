@@ -34,10 +34,10 @@ so quota tests run on a fake clock instead of sleeping.
 
 from __future__ import annotations
 
-import threading
 import time
 
 from .. import telemetry
+from ..resilience import sync as _sync
 from ..resilience.errors import QuESTBackpressureError
 
 __all__ = ["PRIORITIES", "TokenBucket", "AdmissionController"]
@@ -83,7 +83,7 @@ class TokenBucket:
         self._clock = clock
         self._tokens = self.burst
         self._last = clock()
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("admission.bucket")
 
     def _refill_locked(self) -> None:
         now = self._clock()
@@ -137,7 +137,7 @@ class AdmissionController:
         self.quotas = dict(quotas or {})
         self._clock = clock
         self._buckets: dict[str, TokenBucket | None] = {}
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("admission.controller")
 
     def bucket(self, tenant: str) -> TokenBucket | None:
         """The tenant's bucket (created on first use); None = unlimited."""

@@ -28,12 +28,12 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
 from collections import OrderedDict
 
 import numpy as np
 
 from .. import telemetry
+from ..resilience import sync as _sync
 
 __all__ = ["LRUCache", "executables", "structure_fingerprint",
            "enable_persistent_cache"]
@@ -57,7 +57,7 @@ class LRUCache:
         self.name = name
         # re-entrant: a factory may itself route nested executables through
         # the same cache (compiled_blocks builds its per-block replays)
-        self._lock = threading.RLock()
+        self._lock = _sync.RLock("engine.cache")
         self._od: OrderedDict = OrderedDict()
 
     def __len__(self) -> int:

@@ -91,6 +91,7 @@ from concurrent.futures import Future
 from .. import telemetry
 from ..resilience import faultinject as _faults
 from ..resilience import sentinel as _sentinel
+from ..resilience import sync as _sync
 from ..resilience import watchdog as _watchdog
 from ..resilience.errors import (PoisonedRequestFault, QuESTBackpressureError,
                                  QuESTCancelledError, QuESTHangError,
@@ -208,7 +209,7 @@ class Engine:
 
         self._lifted = circuit.lifted()
         self.fingerprint = circuit.fingerprint()
-        self._cv = threading.Condition()
+        self._cv = _sync.Condition("engine.cv")
         self._q: deque = deque()
         self._open = True
         self._health = "healthy"
@@ -306,6 +307,14 @@ class Engine:
         with self._cv:
             return self._health
 
+    def is_open(self) -> bool:
+        """True until :meth:`close` begins; a closed engine rejects every
+        submit with ``RuntimeError``. The pool's dispatch path reads this
+        to distinguish a drain-closed replica (fail over) from a genuine
+        request error (settle)."""
+        with self._cv:
+            return self._open
+
     def revive(self) -> str:
         """Operator acknowledgement after a quarantine: transition
         ``quarantined`` -> ``degraded`` (submits are accepted again, and
@@ -389,17 +398,16 @@ class Engine:
         # re-dispatch) may take other locks, and holding self._cv across
         # arbitrary callbacks invites lock-order inversions
         for req in dropped:
-            if not req.fut.done():
-                # a typed resolution, not Future.cancel(): cancel() is a
-                # no-op on futures a waiter already holds in RUNNING
-                # transitions elsewhere, and CancelledError carries no
-                # context -- this names the drop
-                req.fut.set_exception(QuESTCancelledError(
-                    "request dropped by Engine.close before dispatch",
-                    "Engine.close"))
+            # a typed resolution, not Future.cancel(): cancel() is a
+            # no-op on futures a waiter already holds in RUNNING
+            # transitions elsewhere, and CancelledError carries no
+            # context -- this names the drop
+            _sync.resolve_future(req.fut, exception=QuESTCancelledError(
+                "request dropped by Engine.close before dispatch",
+                "Engine.close"), site="engine.close")
         if self._thread.is_alive() and \
                 self._thread is not threading.current_thread():
-            self._thread.join()
+            _sync.join_thread(self._thread)
         telemetry.set_gauge("engine_queue_depth", 0)
         telemetry.event("engine.close", drained=drain)
 
@@ -483,12 +491,11 @@ class Engine:
         for req in batch:
             if req.deadline is not None and now >= req.deadline:
                 telemetry.inc("engine_request_timeouts_total")
-                if not req.fut.done():
-                    req.fut.set_exception(QuESTTimeoutError(
-                        f"request deadline expired after "
-                        f"{now - req.t0:.3f}s in queue "
-                        f"(timeout={req.deadline - req.t0:.3f}s)",
-                        "Engine.submit"))
+                _sync.resolve_future(req.fut, exception=QuESTTimeoutError(
+                    f"request deadline expired after "
+                    f"{now - req.t0:.3f}s in queue "
+                    f"(timeout={req.deadline - req.t0:.3f}s)",
+                    "Engine.submit"), site="engine.expire")
             else:
                 live.append(req)
         return live
@@ -527,15 +534,15 @@ class Engine:
             # fail the batch typed and quarantine the engine
             self._note_breach(hang=True)
             for req in batch:
-                if not req.fut.done():
-                    req.fut.set_exception(e)
+                _sync.resolve_future(req.fut, exception=e,
+                                     site="engine.dispatch")
         except QuESTIntegrityError as e:
             # a corrupt result was caught BEFORE any future resolved with
             # it: fail the remainder typed, degrade (quarantine on repeat)
             self._note_breach(hang=False)
             for req in batch:
-                if not req.fut.done():
-                    req.fut.set_exception(e)
+                _sync.resolve_future(req.fut, exception=e,
+                                     site="engine.dispatch")
         except Exception:
             # a failed batch bisects through the same executable: healthy
             # requests complete bit-identically, poisoned ones carry their
@@ -543,8 +550,8 @@ class Engine:
             self._bisect(batch, mode)
         except BaseException as e:  # interpreter teardown must not hang waiters
             for req in batch:
-                if not req.fut.done():
-                    req.fut.set_exception(e)
+                _sync.resolve_future(req.fut, exception=e,
+                                     site="engine.dispatch")
         else:
             self._note_clean()
         now = time.perf_counter()
@@ -552,6 +559,9 @@ class Engine:
             telemetry.observe("engine_request_latency_seconds", now - req.t0)
 
     def _dispatch_one(self, batch: list, mode: str) -> None:
+        # device dispatch is a blocking boundary: flight-record QT602 if
+        # any instrumented lock is still held on the dispatching thread
+        _sync.guard_blocking("engine.dispatch")
         if mode == "vmap":
             self._dispatch_vmap(batch)
         else:
@@ -566,8 +576,8 @@ class Engine:
             except BaseException as e:
                 if req.poison is not None:
                     telemetry.inc("engine_poisoned_requests_total")
-                if not req.fut.done():
-                    req.fut.set_exception(e)
+                _sync.resolve_future(req.fut, exception=e,
+                                     site="engine.bisect")
             return
         mid = len(batch) // 2
         for half in (batch[:mid], batch[mid:]):
@@ -613,8 +623,8 @@ class Engine:
             res = self._maybe_corrupt(
                 x.with_values(self.initial_amps + 0, req.values))
             self._sentinel_gate(res)
-            if not req.fut.done():
-                req.fut.set_result(res)
+            _sync.resolve_future(req.fut, result=res,
+                                 site="engine.dispatch")
 
     def _dispatch_vmap(self, batch: list) -> None:
         import jax.numpy as jnp
@@ -632,8 +642,8 @@ class Engine:
                 self._exec1().with_values(self.initial_amps + 0, ()))
             self._sentinel_gate(out)
             for req in batch:
-                if not req.fut.done():
-                    req.fut.set_result(out)
+                _sync.resolve_future(req.fut, result=out,
+                                     site="engine.dispatch")
             return
         pad = self.max_batch - len(batch)
         vals = [req.values for req in batch] + [batch[-1].values] * pad
@@ -646,5 +656,5 @@ class Engine:
         for i, req in enumerate(batch):
             lane = self._maybe_corrupt(out[i])
             self._sentinel_gate(lane)
-            if not req.fut.done():
-                req.fut.set_result(lane)
+            _sync.resolve_future(req.fut, result=lane,
+                                 site="engine.dispatch")

@@ -67,7 +67,12 @@ Locking: the pool condition variable orders BEFORE any engine lock --
 pool code may read engine health under the pool lock, but never holds an
 engine lock while taking the pool lock (engine done callbacks run with
 no engine lock held; ``Engine.close`` resolves cancelled futures after
-releasing its lock for exactly this reason).
+releasing its lock for exactly this reason). Both locks live on the
+instrumented sync layer (:mod:`quest_tpu.resilience.sync`: ``pool.cv``
+orders before ``engine.cv``), so with ``QUEST_CONCHECK=1`` the ordering
+contract is *verified* -- an inversion shows up as a QT601 cycle in the
+lock-order graph, and a future resolved under either lock as QT602
+(docs/analysis.md, the round-15 concurrency verifier).
 """
 
 from __future__ import annotations
@@ -80,6 +85,7 @@ from concurrent.futures import Future
 from .. import telemetry
 from ..resilience import faultinject as _faults
 from ..resilience import retry as _retry
+from ..resilience import sync as _sync
 from ..resilience.errors import (QuESTBackpressureError, QuESTCancelledError,
                                  QuESTHangError, QuESTIntegrityError,
                                  QuESTRetryError)
@@ -174,7 +180,7 @@ class _Replica:
         self.state = "healthy"
         self.in_rotation = False
         self.outstanding: set = set()
-        self.build_lock = threading.Lock()
+        self.build_lock = _sync.Lock("pool.build")
 
     def health(self) -> str:
         """Worst of the pool-level state and every member engine's
@@ -222,7 +228,7 @@ class EnginePool:
         self.admission = (admission if admission is not None
                           else AdmissionController(tenant_qps))
         self._spawn_replacements = bool(spawn_replacements)
-        self._cv = threading.Condition()
+        self._cv = _sync.Condition("pool.cv")
         self._replicas: list[_Replica] = []
         self._manifest: dict = {}         # fingerprint -> circuit
         self._pending = {p: deque() for p in PRIORITIES}
@@ -379,6 +385,21 @@ class EnginePool:
             telemetry.inc("pool_failovers_total", reason="backpressure")
             self._route(req)
             return
+        except RuntimeError as e:
+            if eng is not None and not eng.is_open():
+                # the quarantine drain closed this engine between routing
+                # and submit (the interleaving explorer's
+                # pool_failover_race window): the drain's zero-lost-futures
+                # contract covers it -- fail over, don't settle
+                req.failed.add(rep.id)
+                req.last_exc = QuESTCancelledError(
+                    f"replica {rep.id} closed during dispatch",
+                    "EnginePool._dispatch")
+                telemetry.inc("pool_failovers_total", reason="closed")
+                self._route(req)
+                return
+            self._settle(req, exc=e)
+            return
         except BaseException as e:
             self._settle(req, exc=e)
             return
@@ -398,10 +419,11 @@ class EnginePool:
                 return False
             req.settled = True
             self._cv.notify_all()
-        if exc is not None:
-            req.fut.set_exception(exc)
-        else:
-            req.fut.set_result(result)
+        # resolution happens OUTSIDE the pool lock (the settled flag above
+        # is the once-guard); resolve_future re-verifies that under
+        # QUEST_CONCHECK=1 (QT602 on any instrumented lock still held)
+        _sync.resolve_future(req.fut, result=result, exception=exc,
+                             site="pool.settle")
         telemetry.observe("pool_request_latency_seconds",
                           time.monotonic() - req.t0)
         return True
@@ -734,7 +756,7 @@ class EnginePool:
                 "request dropped by EnginePool.close before dispatch",
                 "EnginePool.close"))
         for t in workers:
-            t.join()
+            _sync.join_thread(t)
         for rep in reps:
             for eng in list(rep.engines.values()):
                 try:
@@ -742,7 +764,7 @@ class EnginePool:
                 except Exception:  # pragma: no cover
                     pass
         if self._hedge_thread is not None and self._hedge_thread.is_alive():
-            self._hedge_thread.join()
+            _sync.join_thread(self._hedge_thread)
         telemetry.set_gauge("pool_replicas", 0)
         telemetry.event("pool.close", drained=drain)
 

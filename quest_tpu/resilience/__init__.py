@@ -30,6 +30,14 @@ result or failed closed with a typed error. Six pieces:
 - :mod:`.watchdog` -- deadline enforcement (``QUEST_WATCHDOG_MS``)
   around collective launches and engine dispatches: a hung call raises a
   typed ``QuESTHangError`` (QT405) instead of blocking forever.
+- :mod:`.sync` -- named, instrumented lock/condition primitives for the
+  whole serving fleet (``QUEST_CONCHECK=1``): per-lock acquisition/hold
+  telemetry, the held-while-acquiring order graph behind the QT601
+  deadlock analysis, QT602 blocking-boundary guards, the
+  ``resolve_future`` once-resolution helper, ``chaos_drop_lock``
+  mutation hook, and the controller seam the
+  :class:`~quest_tpu.analysis.concheck.InterleavingExplorer` schedules
+  through. One boolean of overhead when off (the default).
 
 Typed errors (:mod:`.errors`) subclass
 :class:`~quest_tpu.validation.QuESTError`:
@@ -61,7 +69,12 @@ from .segmented import (  # noqa: F401
     resume_segmented, run_segmented, segment_plan,
 )
 from . import sentinel  # noqa: F401
+from . import sync  # noqa: F401
 from . import watchdog  # noqa: F401
+from .sync import (  # noqa: F401
+    chaos_drop_lock, checking, guard_blocking, held_locks, join_thread,
+    lock_order_edges, resolve_future,
+)
 from .sentinel import SentinelPolicy, SentinelSpec, sentinel_policy  # noqa: F401
 from .watchdog import watchdog_deadline  # noqa: F401
 
@@ -77,4 +90,6 @@ __all__ = [
     "segment_plan", "run_segmented", "resume_segmented",
     "sentinel", "SentinelPolicy", "SentinelSpec", "sentinel_policy",
     "watchdog", "watchdog_deadline",
+    "sync", "checking", "held_locks", "lock_order_edges", "guard_blocking",
+    "resolve_future", "join_thread", "chaos_drop_lock",
 ]

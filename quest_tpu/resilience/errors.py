@@ -17,6 +17,8 @@ handlers keep working.
 
 from __future__ import annotations
 
+from typing import Iterable
+
 from ..validation import QuESTError
 
 __all__ = [
@@ -42,7 +44,7 @@ class QuESTBackpressureError(QuESTError):
     ``"pool_capacity"`` (None on legacy raisers)."""
 
     def __init__(self, message: str, func: str = "",
-                 reason: str | None = None):
+                 reason: str | None = None) -> None:
         super().__init__(message, func)
         self.reason = reason
 
@@ -61,7 +63,7 @@ class QuESTPreemptionError(QuESTError):
 
     def __init__(self, message: str, func: str = "",
                  cursor: int | None = None,
-                 checkpoint_dir: str | None = None):
+                 checkpoint_dir: str | None = None) -> None:
         super().__init__(message, func)
         self.cursor = cursor
         self.checkpoint_dir = checkpoint_dir
@@ -82,7 +84,8 @@ class QuESTIntegrityError(QuESTError):
     :class:`~quest_tpu.analysis.diagnostics.Finding` records) so callers
     can name the breached invariant and the divergent shard."""
 
-    def __init__(self, message: str, func: str = "", findings=()):
+    def __init__(self, message: str, func: str = "",
+                 findings: Iterable[object] = ()) -> None:
         super().__init__(message, func)
         self.findings = list(findings)
 
@@ -95,7 +98,7 @@ class QuESTHangError(QuESTError):
 
     def __init__(self, message: str, func: str = "",
                  site: str | None = None,
-                 deadline_ms: float | None = None):
+                 deadline_ms: float | None = None) -> None:
         super().__init__(message, func)
         self.site = site
         self.deadline_ms = deadline_ms
@@ -111,7 +114,7 @@ class QuESTChecksumError(QuESTError):
     def __init__(self, message: str, func: str = "",
                  shard: str | None = None,
                  expected_crc: int | None = None,
-                 actual_crc: int | None = None):
+                 actual_crc: int | None = None) -> None:
         super().__init__(message, func)
         self.shard = shard
         self.expected_crc = expected_crc
@@ -122,7 +125,7 @@ class InjectedFault(RuntimeError):
     """Base for faults raised by :mod:`~quest_tpu.resilience.faultinject`
     at a named site (never raised when ``QUEST_FAULTS`` is unset)."""
 
-    def __init__(self, site: str, kind: str):
+    def __init__(self, site: str, kind: str) -> None:
         super().__init__(f"injected {kind} fault at site {site!r}")
         self.site = site
         self.kind = kind

@@ -68,11 +68,11 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 from typing import Iterator, NamedTuple
 
 from .. import telemetry
 from ..validation import QuESTError
+from . import sync as _sync
 from .errors import (InjectedFault, KernelCompileFault, PoisonedRequestFault,
                      QuESTPreemptionError, TransientFault)
 
@@ -136,10 +136,11 @@ class FaultPlan:
     counters live on the plan, so installing a fresh plan restarts the
     deterministic visit numbering)."""
 
-    def __init__(self, specs: Iterator[FaultSpec] | tuple = ()):
+    def __init__(self,
+                 specs: Iterator[FaultSpec] | tuple = ()) -> None:
         self.specs: tuple[FaultSpec, ...] = tuple(specs)
         self._visits: dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock("faultinject.plan")
 
     @classmethod
     def parse(cls, text: str, strict: bool = False) -> "FaultPlan":
@@ -197,7 +198,7 @@ class FaultPlan:
 
 _active: FaultPlan | None = None
 _env_read = False
-_state_lock = threading.Lock()
+_state_lock = _sync.Lock("faultinject.state")
 
 
 def _load_env() -> None:
@@ -244,7 +245,7 @@ def clear() -> None:
 
 
 @contextlib.contextmanager
-def fault_plan(plan: FaultPlan | str):
+def fault_plan(plan: FaultPlan | str) -> Iterator[FaultPlan | None]:
     """Context manager installing ``plan`` for the block (tests/chaos);
     restores the previous plan -- and its visit counters -- on exit."""
     global _active, _env_read

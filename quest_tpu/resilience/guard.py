@@ -33,12 +33,15 @@ documented exit:
 
 from __future__ import annotations
 
-from typing import Callable, TypeVar
+from typing import TYPE_CHECKING, Callable, TypeVar
 
 from .. import telemetry
 from . import faultinject, retry, watchdog
 from .errors import (KernelCompileFault, QuESTIntegrityError,
                      QuESTRetryError, TransientFault)
+
+if TYPE_CHECKING:
+    import jax
 
 __all__ = ["DEGRADED", "pallas_dispatch", "collective", "checkpoint_write",
            "segment_boundary", "corrupt_amps", "sentinel_replay"]
@@ -51,7 +54,7 @@ DEGRADED = object()
 
 def pallas_dispatch(attempt: Callable[[], T],
                     degrade: Callable[[], object] | None = None,
-                    *, site: str = "pallas.dispatch"):
+                    *, site: str = "pallas.dispatch") -> T | object:
     """Run a kernel-route ``attempt``: retry injected transients; on a
     compile fault or retry exhaustion run ``degrade`` (the caller's
     engine-replay closure) and return :data:`DEGRADED`, counting the
@@ -170,7 +173,8 @@ def segment_boundary(cursor: int, checkpoint_dir: str) -> None:
             cursor=cursor, checkpoint_dir=checkpoint_dir)
 
 
-def corrupt_amps(amps, *, site: str = "state.corrupt"):
+def corrupt_amps(amps: jax.Array, *,
+                 site: str = "state.corrupt") -> jax.Array:
     """Visit the SDC injection site over a planar ``(2, N)`` amplitude
     array: on a ``bitflip[<shard>]`` fire, flip the top exponent bit of
     one real-plane amplitude in the middle of the named shard's chunk

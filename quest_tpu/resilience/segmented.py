@@ -56,10 +56,19 @@ import json
 import os
 import shutil
 
+from typing import TYPE_CHECKING
+
 from .. import telemetry
 from ..validation import QuESTError
 from . import faultinject, guard, sentinel
 from .errors import QuESTChecksumError, QuESTIntegrityError
+
+if TYPE_CHECKING:
+    from ..analysis.diagnostics import Finding
+    from ..circuits import Circuit
+    from ..environment import QuESTEnv
+    from ..registers import Qureg
+    from .sentinel import SentinelPolicy
 
 __all__ = ["segment_plan", "run_segmented", "resume_segmented"]
 
@@ -81,7 +90,7 @@ def _qt305(gen_dir: str, why: str) -> None:
         "resilience.segmented")])
 
 
-def _qt305_crc(gen_dir: str, e) -> None:
+def _qt305_crc(gen_dir: str, e: QuESTChecksumError) -> None:
     from ..analysis.diagnostics import emit_findings, make_finding
     expected = e.expected_crc if e.expected_crc is not None else 0
     actual = e.actual_crc if e.actual_crc is not None else 0
@@ -98,7 +107,7 @@ def _qt305_crc(gen_dir: str, e) -> None:
 from ..segments import _swap_blocks  # noqa: F401  (compat re-export)
 
 
-def segment_plan(tape, nsv: int, every_n_items: int = 1) -> list:
+def segment_plan(tape: list, nsv: int, every_n_items: int = 1) -> list:
     """The selected checkpoint cuts for ``tape``: a sorted list of tape
     indices starting at 0 and ending at ``len(tape)``, each a
     frame-identity boundary, spaced at least ``every_n_items`` tape
@@ -157,8 +166,8 @@ def _gen_dirs(checkpoint_dir: str) -> list:
     return [p for _, p in sorted(out)]
 
 
-def _checkpoint(circuit, qureg, checkpoint_dir: str, cursor: int,
-                every_n_items: int, keep: int) -> str:
+def _checkpoint(circuit: Circuit, qureg: Qureg, checkpoint_dir: str,
+                cursor: int, every_n_items: int, keep: int) -> str:
     from ..checkpoint import saveQureg
 
     gen = os.path.join(checkpoint_dir, f"{_GEN_PREFIX}{cursor:08d}")
@@ -177,7 +186,8 @@ def _checkpoint(circuit, qureg, checkpoint_dir: str, cursor: int,
     return gen
 
 
-def _run_segment(circuit, qureg, lo: int, hi: int) -> None:
+def _run_segment(circuit: Circuit, qureg: Qureg, lo: int,
+                 hi: int) -> None:
     # round 13: the segment rides quest_tpu.segments.run_slice -- ONE
     # segment-program dispatch, cached on the PARENT circuit's stable
     # token (the pre-round-13 path built a throwaway Circuit per segment
@@ -209,7 +219,8 @@ def _capture_baseline(qureg):
     return np.array(qureg.amps), rng
 
 
-def _rollback(qureg, lo: int, checkpoint_dir: str, baseline) -> None:
+def _rollback(qureg: Qureg, lo: int, checkpoint_dir: str,
+              baseline: tuple | None) -> None:
     telemetry.event("segmented.rollback", cursor=lo,
                     source="baseline" if baseline is not None else "gen")
     if baseline is not None:
@@ -231,14 +242,16 @@ def _rollback(qureg, lo: int, checkpoint_dir: str, baseline) -> None:
     qureg.put(restored.amps)
 
 
-def _heal(circuit, qureg, lo: int, hi: int, checkpoint_dir: str,
-          baseline, policy, findings) -> None:
+def _heal(circuit: Circuit, qureg: Qureg, lo: int, hi: int,
+          checkpoint_dir: str, baseline: tuple | None,
+          policy: SentinelPolicy | None,
+          findings: list[Finding]) -> None:
     """Drive rollback-and-replay for a breached segment ``[lo, hi)``."""
     where = f"segment[{lo}:{hi}]"
     telemetry.event("segmented.heal", lo=lo, hi=hi,
                     codes=",".join(f.code for f in findings))
 
-    def _recheck(stage: str):
+    def _recheck(stage: str) -> None:
         # tick=0 is divisible by every cadence: a healing re-check always
         # runs ALL armed sentinel kinds, whatever the boundary schedule
         again = sentinel.check_qureg(qureg, policy=policy, tick=0,
@@ -274,8 +287,9 @@ def _heal(circuit, qureg, lo: int, hi: int, checkpoint_dir: str,
     guard.sentinel_replay(replay, degrade, site="segment.sentinel")
 
 
-def _execute(circuit, qureg, cuts, start: int, checkpoint_dir: str,
-             every_n_items: int, keep: int):
+def _execute(circuit: Circuit, qureg: Qureg, cuts: list, start: int,
+             checkpoint_dir: str, every_n_items: int,
+             keep: int) -> Qureg:
     armed = sentinel.enabled()
     policy = sentinel.active_policy() if armed else None
     tick = 0
@@ -304,8 +318,9 @@ def _execute(circuit, qureg, cuts, start: int, checkpoint_dir: str,
     return qureg
 
 
-def run_segmented(circuit, target, *, checkpoint_dir: str,
-                  every_n_items: int = 1, keep: int = 2):
+def run_segmented(circuit: Circuit, target: QuESTEnv | Qureg, *,
+                  checkpoint_dir: str, every_n_items: int = 1,
+                  keep: int = 2) -> Qureg:
     """Execute ``circuit`` segment by segment (see module docstring).
 
     ``target`` is a :class:`~quest_tpu.environment.QuESTEnv` (a fresh
@@ -325,8 +340,10 @@ def run_segmented(circuit, target, *, checkpoint_dir: str,
                     every_n_items, keep)
 
 
-def resume_segmented(circuit, checkpoint_dir: str, env, *,
-                     every_n_items: int | None = None, keep: int = 2):
+def resume_segmented(circuit: Circuit, checkpoint_dir: str,
+                     env: QuESTEnv, *,
+                     every_n_items: int | None = None,
+                     keep: int = 2) -> Qureg:
     """Restart a :func:`run_segmented` execution from the last VERIFIED
     generation under ``checkpoint_dir`` (see module docstring), replaying
     the remaining segments; returns the final register. ``every_n_items``

@@ -50,13 +50,19 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
-from typing import Iterable, NamedTuple
+from typing import TYPE_CHECKING, Iterable, Iterator, NamedTuple
 
 import numpy as np
 
 from .. import telemetry
 from ..validation import QuESTError
+from . import sync as _sync
+
+if TYPE_CHECKING:
+    import jax
+
+    from ..analysis.diagnostics import Finding
+    from ..registers import Qureg
 
 __all__ = ["KINDS", "ENV_VAR", "DEFAULT_SPEC", "SentinelSpec",
            "SentinelPolicy", "enabled", "active_policy", "install",
@@ -78,7 +84,7 @@ DEFAULT_SPEC = "norm:segment,checksum:segment"
 _TOL = {np.dtype(np.float32): 1e-4, np.dtype(np.float64): 1e-9}
 
 
-def tolerance(dtype) -> float:
+def tolerance(dtype: np.dtype | type | str) -> float:
     """The drift band for a register of real ``dtype`` (see module
     docstring); unknown dtypes get the conservative f32 band."""
     return _TOL.get(np.dtype(dtype), 1e-4)
@@ -106,7 +112,8 @@ class SentinelSpec(NamedTuple):
 class SentinelPolicy:
     """A parsed sentinel policy: which kinds run, at what cadence."""
 
-    def __init__(self, specs: Iterable[SentinelSpec] | tuple = ()):
+    def __init__(self,
+                 specs: Iterable[SentinelSpec] | tuple = ()) -> None:
         self.specs: tuple[SentinelSpec, ...] = tuple(specs)
 
     @classmethod
@@ -165,7 +172,7 @@ class SentinelPolicy:
 
 _active: SentinelPolicy | None = None
 _env_read = False
-_state_lock = threading.Lock()
+_state_lock = _sync.Lock("sentinel.state")
 
 
 def _load_env() -> None:
@@ -213,7 +220,8 @@ def clear() -> None:
 
 
 @contextlib.contextmanager
-def sentinel_policy(policy: SentinelPolicy | str):
+def sentinel_policy(
+        policy: SentinelPolicy | str) -> Iterator[SentinelPolicy | None]:
     """Context manager arming ``policy`` for the block (tests/bench);
     restores the previous policy on exit."""
     global _active, _env_read
@@ -228,7 +236,7 @@ def sentinel_policy(policy: SentinelPolicy | str):
 
 # -- the checks -------------------------------------------------------------
 
-def _finding(code: str, message: str, where: str):
+def _finding(code: str, message: str, where: str) -> Finding:
     from ..analysis.diagnostics import emit_findings, make_finding
     f = make_finding(code, message, where or "resilience.sentinel")
     emit_findings([f])
@@ -272,7 +280,8 @@ def _shard_partials(amps, mesh):
     return out[0], out[1]
 
 
-def _check_norm(amps, density: bool, n: int, tol: float, where: str):
+def _check_norm(amps: jax.Array, density: bool, n: int, tol: float,
+                where: str) -> Finding | None:
     from ..ops import reduce as R
 
     if density:
@@ -289,7 +298,9 @@ def _check_norm(amps, density: bool, n: int, tol: float, where: str):
         f"{tol:.1e} band for dtype {np.dtype(amps.dtype).name}", where)
 
 
-def _check_checksum(amps, density: bool, tol: float, where: str, mesh):
+def _check_checksum(amps: jax.Array, density: bool, tol: float,
+                    where: str,
+                    mesh: jax.sharding.Mesh | None) -> Finding | None:
     partials, totals = _shard_partials(amps, mesh)
     # sum|amps|^2 is the norm (statevec) or purity (density): both must
     # land in [0, 1] within the band, and every shard's folded total
@@ -310,7 +321,8 @@ def _check_checksum(amps, density: bool, tol: float, where: str, mesh):
         f"band {tol:.1e}, {len(partials)} shard(s))", where)
 
 
-def _check_trace(amps, density: bool, n: int, tol: float, where: str):
+def _check_trace(amps: jax.Array, density: bool, n: int, tol: float,
+                 where: str) -> Finding | str | None:
     if not density:
         return "skipped"
     from ..ops import reduce as R
@@ -332,8 +344,10 @@ def _check_trace(amps, density: bool, n: int, tol: float, where: str):
         f"max |rho - rho^H| = {asym:.3e}, band {tol:.1e}", where)
 
 
-def check_amps(amps, *, density: bool = False, n: int | None = None,
-               mesh=None, policy: SentinelPolicy | None = None,
+def check_amps(amps: jax.Array, *, density: bool = False,
+               n: int | None = None,
+               mesh: jax.sharding.Mesh | None = None,
+               policy: SentinelPolicy | None = None,
                tick: int = 1, where: str = "") -> list:
     """Run every armed sentinel due at opportunity ``tick`` over a
     planar ``(2, 2**nsv)`` amplitude array; returns the breach findings
@@ -366,7 +380,7 @@ def check_amps(amps, *, density: bool = False, n: int | None = None,
     return findings
 
 
-def check_qureg(qureg, *, policy: SentinelPolicy | None = None,
+def check_qureg(qureg: Qureg, *, policy: SentinelPolicy | None = None,
                 tick: int = 1, where: str = "") -> list:
     """:func:`check_amps` over a live register (mesh inferred from its
     sharding)."""

@@ -1,0 +1,559 @@
+"""Instrumented synchronization primitives for the serving fleet.
+
+The serving path is a web of threads -- the engine batcher, the pool's
+quarantine drainers / replacement spawners / hedge loop, admission
+buckets, the watchdog -- and until round 15 its safety was proven only
+anecdotally (the round-13 quarantined-``close`` fix was found by hand).
+This module is the substrate the concurrency verifier
+(:mod:`quest_tpu.analysis.concheck`) analyses: named, thin wrappers over
+``threading.Lock`` / ``RLock`` / ``Condition`` that every lock in the
+serving stack constructs instead of the raw primitives.
+
+With ``QUEST_CONCHECK`` unset/0 (the default) each operation is a
+pass-through costing one module-boolean read -- the same zero-overhead
+discipline as :mod:`.faultinject` and :mod:`.watchdog`. With
+``QUEST_CONCHECK=1`` (or :func:`configure`), every acquire/release:
+
+- maintains a per-thread held-lock stack (:func:`held_locks`),
+- records the **held-while-acquiring** edge into the process-global
+  lock-order graph (:func:`lock_order_edges`; the acquisition stack is
+  captured once, on the first occurrence of each edge) -- the input to
+  concheck's QT601 deadlock-cycle analysis,
+- counts ``lock_acquisitions_total{lock}`` and observes
+  ``lock_hold_ms{lock}`` on the telemetry registry (lock *names* are
+  role strings -- ``engine.cv``, ``pool.cv`` -- so metric cardinality is
+  bounded by the number of lock roles, not lock instances),
+- checks the QT602 family at declared blocking boundaries:
+  :func:`guard_blocking` (device dispatch), :func:`resolve_future`
+  (future resolution while holding any instrumented lock -- the exact
+  round-13 bug class), condition wait while holding a *different*
+  instrumented lock, and :func:`join_thread`.
+
+Malformed ``QUEST_CONCHECK`` values warn once with QT605 via
+:func:`~quest_tpu.analysis.diagnostics.parse_env_int`.
+
+Two test-only hooks complete the verifier loop:
+
+- :func:`chaos_drop_lock` -- make one named lock a no-op for a block
+  (the "deleted lock" mutation): the un-acquired condition wait is then
+  detected deterministically instead of surfacing as a data race.
+- :func:`set_controller` -- installs the deterministic interleaving
+  explorer (:class:`quest_tpu.analysis.concheck.InterleavingExplorer`);
+  every primitive routes controlled threads through it so schedules are
+  serialized at these yield points.
+
+Import discipline: this module imports ONLY the stdlib at module scope
+(telemetry and diagnostics are imported lazily at call time), so
+:mod:`quest_tpu.telemetry` -- whose registry lock this module supplies --
+can exist below it without a cycle.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import traceback
+from typing import TYPE_CHECKING, Any, Callable, Iterator, Optional
+
+if TYPE_CHECKING:
+    from ..analysis.diagnostics import Finding
+
+__all__ = [
+    "ENV", "Lock", "RLock", "Condition",
+    "checking", "configure", "reset",
+    "held_locks", "lock_order_edges", "reset_graph",
+    "guard_blocking", "resolve_future", "join_thread",
+    "blocking_findings", "reset_findings",
+    "chaos_drop_lock", "set_controller", "get_controller",
+]
+
+ENV = "QUEST_CONCHECK"
+
+#: cap on retained QT602 findings (telemetry still counts every one)
+_MAX_FINDINGS = 256
+
+#: frames kept per first-occurrence edge stack
+_STACK_LIMIT = 16
+
+_env_read = False
+_active = False
+_warned: set = set()
+
+#: the installed interleaving explorer (analysis.concheck), or None
+_controller: Any = None
+
+#: lock names currently no-op'ed by :func:`chaos_drop_lock`
+_dropped: set = set()
+
+_tls = threading.local()
+
+#: (held_name, acquiring_name) -> {"count": int, "stack": str}
+_graph: dict = {}
+# the recorder's own latch -- deliberately raw (instrumenting the
+# instrumenter would recurse); sync.py is allowlisted by the QT604 lint
+_graph_guard = threading.Lock()
+
+_qt602_list: list = []
+
+
+# ---------------------------------------------------------------------------
+# enablement (QUEST_CONCHECK, lazy like watchdog.deadline_s)
+# ---------------------------------------------------------------------------
+
+def _load_env() -> None:
+    global _env_read, _active
+    if _env_read:
+        return
+    # set the latch FIRST: a malformed value's QT605 emission routes
+    # through telemetry -> the registry lock -> back into this module
+    _env_read = True
+    from ..analysis.diagnostics import parse_env_int
+    val = parse_env_int(ENV, 0, minimum=0, code="QT605", warned=_warned,
+                        noun="concheck mode")
+    _active = val >= 1
+
+
+def checking() -> bool:
+    """True when the instrumented paths are recording (``QUEST_CONCHECK``
+    >= 1 or an in-process :func:`configure` override)."""
+    if not _env_read:
+        _load_env()
+    return _active
+
+
+def configure(on: bool) -> None:
+    """Enable/disable checking in-process, overriding ``QUEST_CONCHECK``.
+    Toggle only at quiescent points: a lock acquired while checking was
+    off is invisible to the held stack, so flipping mid-hold can misread
+    guards (the suite toggles between requests, never inside one)."""
+    global _env_read, _active
+    _env_read = True
+    _active = bool(on)
+
+
+def reset() -> None:
+    """Drop the :func:`configure` override and the cached env read."""
+    global _env_read, _active
+    _env_read = False
+    _active = False
+
+
+# ---------------------------------------------------------------------------
+# per-thread held stack + lock-order graph
+# ---------------------------------------------------------------------------
+
+class _Held:
+    __slots__ = ("lock", "t0", "depth")
+
+    def __init__(self, lock: "Lock", t0: float) -> None:
+        self.lock = lock
+        self.t0 = t0
+        self.depth = 1
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def held_locks() -> tuple:
+    """Names of the instrumented locks the CURRENT thread holds,
+    outermost first (empty when checking is off)."""
+    return tuple(h.lock.name for h in _held_stack())
+
+
+def _record_edge(held_name: str, acquiring_name: str) -> None:
+    if held_name == acquiring_name:
+        return
+    key = (held_name, acquiring_name)
+    with _graph_guard:
+        e = _graph.get(key)
+        if e is None:
+            # the stack is captured ONLY on an edge's first occurrence:
+            # steady-state acquisitions pay one dict hit + one int add
+            _graph[key] = {
+                "count": 1,
+                "stack": "".join(traceback.format_stack(limit=_STACK_LIMIT)
+                                 [:-2]),
+            }
+        else:
+            e["count"] += 1
+
+
+def lock_order_edges() -> dict:
+    """A copy of the held-while-acquiring graph recorded so far:
+    ``{(held, acquiring): {"count", "stack"}}`` -- concheck's QT601
+    input."""
+    with _graph_guard:
+        return {k: dict(v) for k, v in _graph.items()}
+
+
+def reset_graph() -> None:
+    """Drop every recorded lock-order edge (tests isolate runs)."""
+    with _graph_guard:
+        _graph.clear()
+
+
+# ---------------------------------------------------------------------------
+# QT602: blocking boundaries and future resolution under a lock
+# ---------------------------------------------------------------------------
+
+def _qt602(site: str, held: tuple, what: str) -> "Finding":
+    from ..analysis.diagnostics import emit_findings, make_finding
+    f = make_finding(
+        "QT602", f"{what} at {site!r} while holding instrumented lock(s) "
+                 f"{', '.join(held)}", f"sync.guard[{site}]")
+    if len(_qt602_list) < _MAX_FINDINGS:
+        _qt602_list.append(f)
+    emit_findings([f])
+    return f
+
+
+def guard_blocking(site: str) -> None:
+    """Declare a blocking boundary (device dispatch, thread join, a
+    ``Future.result()`` wait): flight-records QT602 when the current
+    thread holds ANY instrumented lock here. One boolean when checking
+    is off."""
+    if not _env_read:
+        _load_env()
+    if not _active:
+        return
+    held = held_locks()
+    if held:
+        _qt602(site, held, "blocking boundary crossed")
+
+
+def resolve_future(fut: Any, *, result: Any = None,
+                   exception: BaseException | None = None,
+                   site: str = "") -> bool:
+    """The ONE future-resolution helper for engine/pool code: resolves
+    ``fut`` (exception wins when given) behind the usual ``done()``
+    guard, and flight-records QT602 when the resolving thread still
+    holds an instrumented lock -- resolution runs arbitrary done
+    callbacks (the pool's failover re-dispatch), so doing it under a
+    lock is the round-13 deadlock class. Returns True when this call
+    resolved the future."""
+    if not _env_read:
+        _load_env()
+    if _active:
+        held = held_locks()
+        if held:
+            _qt602(site, held, "future resolved")
+    if fut.done():
+        return False
+    if exception is not None:
+        fut.set_exception(exception)
+    else:
+        fut.set_result(result)
+    return True
+
+
+def blocking_findings() -> list:
+    """The QT602 findings recorded since the last :func:`reset_findings`
+    (capped at 256; telemetry counts every occurrence)."""
+    return list(_qt602_list)
+
+
+def reset_findings() -> None:
+    """Drop the retained QT602 findings."""
+    del _qt602_list[:]
+
+
+def join_thread(t: threading.Thread, timeout: Optional[float] = None) -> None:
+    """Controller-aware ``t.join()``: under the interleaving explorer the
+    join becomes a yield point (eligible once ``t`` finishes); otherwise
+    it is a plain join behind a QT602 blocking-boundary guard."""
+    ctrl = _controller
+    if ctrl is not None and ctrl.controls_current():
+        ctrl.op_join(t, timeout)
+        return
+    guard_blocking(f"join:{t.name}")
+    t.join(timeout)
+
+
+# ---------------------------------------------------------------------------
+# mutation + explorer hooks
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def chaos_drop_lock(name: str) -> Iterator[None]:
+    """Mutation hook: make every lock named ``name`` a no-op for the
+    block (acquire succeeds without locking, release does nothing).
+    This is the "deleted lock" seeded mutation the concurrency verifier
+    must catch: a condition wait on the dropped lock then raises
+    deterministically (the un-acquired wait), and the interleaving
+    explorer sees the invariant breach the lost mutual exclusion causes.
+    Checking is forced ON inside the block so the instrumented paths
+    (where the drop takes effect) are active."""
+    global _env_read, _active
+    prev = (_env_read, _active)
+    _env_read = True
+    _active = True
+    _dropped.add(name)
+    try:
+        yield
+    finally:
+        _dropped.discard(name)
+        _env_read, _active = prev
+
+
+def set_controller(ctrl: Any) -> None:
+    """Install (or clear, with None) the deterministic interleaving
+    explorer. While installed, every primitive asks it to intercept the
+    calling thread; uncontrolled threads use the normal paths."""
+    global _controller
+    _controller = ctrl
+
+
+def get_controller():
+    """The installed interleaving explorer, or None."""
+    return _controller
+
+
+# ---------------------------------------------------------------------------
+# checked operation bodies (shared by Lock and RLock)
+# ---------------------------------------------------------------------------
+
+def _acquire_checked(lock: "Lock", blocking: bool, timeout: float) -> bool:
+    if lock.name in _dropped:
+        return True
+    held = _held_stack()
+    if lock.reentrant:
+        for h in held:
+            if h.lock is lock:
+                h.depth += 1
+                return lock._real.acquire(blocking, timeout)
+    for h in held:
+        _record_edge(h.lock.name, lock.name)
+    ok = lock._real.acquire(blocking, timeout)
+    if ok:
+        held.append(_Held(lock, time.perf_counter()))
+        if lock.record:
+            from .. import telemetry
+            telemetry.inc("lock_acquisitions_total", lock=lock.name)
+    return ok
+
+
+def _release_checked(lock: "Lock") -> None:
+    if lock.name in _dropped:
+        return
+    held = _held_stack()
+    for i in range(len(held) - 1, -1, -1):
+        h = held[i]
+        if h.lock is lock:
+            if h.depth > 1:
+                h.depth -= 1
+                lock._real.release()
+                return
+            del held[i]
+            lock._real.release()
+            if lock.record:
+                from .. import telemetry
+                telemetry.observe(
+                    "lock_hold_ms",
+                    (time.perf_counter() - h.t0) * 1e3, lock=lock.name)
+            return
+    # acquired before checking was enabled: release untracked
+    lock._real.release()
+
+
+class Lock:
+    """Named wrapper over ``threading.Lock`` (module docstring).
+    ``record=False`` keeps a lock on the instrumented layer (held stack,
+    order graph, guards) without telemetry metrics -- the telemetry
+    registry's own lock uses it to avoid recording recursion."""
+
+    __slots__ = ("name", "record", "_real")
+
+    reentrant = False
+
+    def __init__(self, name: str = "lock", *,
+                 record: bool = True) -> None:
+        self.name = name
+        self.record = record
+        self._real = self._make_real()
+
+    @staticmethod
+    def _make_real():
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ctrl = _controller
+        if ctrl is not None and ctrl.controls_current():
+            return ctrl.op_acquire(self, blocking, timeout)
+        if not _env_read:
+            _load_env()
+        if not _active:
+            return self._real.acquire(blocking, timeout)
+        return _acquire_checked(self, blocking, timeout)
+
+    def release(self) -> None:
+        ctrl = _controller
+        if ctrl is not None and ctrl.controls_current():
+            ctrl.op_release(self)
+            return
+        if not _active:
+            self._real.release()
+            return
+        _release_checked(self)
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<sync.{type(self).__name__} {self.name!r}>"
+
+
+class RLock(Lock):
+    """Named wrapper over ``threading.RLock``: re-entrant acquisitions
+    deepen the existing held entry instead of re-recording edges (a
+    self-edge is never an ordering fact)."""
+
+    __slots__ = ()
+
+    reentrant = True
+
+    @staticmethod
+    def _make_real():
+        return threading.RLock()
+
+
+class Condition:
+    """Named wrapper over ``threading.Condition`` sharing its lock with
+    the instrumented :class:`Lock` wrapper (pass ``lock=`` to build a
+    condition over an existing instrumented lock). ``wait`` mirrors the
+    real release/reacquire in the held stack -- hold-time metrics
+    exclude the wait, and an un-acquired wait (the dropped-lock
+    mutation) raises deterministically. Waiting while holding a
+    DIFFERENT instrumented lock flight-records QT602."""
+
+    __slots__ = ("name", "_lock", "_real")
+
+    def __init__(self, name: str = "cond", *,
+                 lock: Optional[Lock] = None,
+                 record: bool = True) -> None:
+        if lock is None:
+            lock = Lock(name, record=record)
+        self._lock = lock
+        self.name = lock.name
+        self._real = threading.Condition(lock._real)
+
+    # -- lock protocol -------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._lock.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> "Condition":
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._lock.release()
+        return False
+
+    # -- condition protocol --------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ctrl = _controller
+        if ctrl is not None and ctrl.controls_current():
+            return ctrl.op_wait(self, timeout)
+        if not _env_read:
+            _load_env()
+        if not _active:
+            return self._real.wait(timeout)
+        held = _held_stack()
+        ent = None
+        for h in held:
+            if h.lock is self._lock:
+                ent = h
+                break
+        if ent is None:
+            raise RuntimeError(
+                f"cannot wait on un-acquired instrumented lock "
+                f"{self.name!r}"
+                + (" (dropped by chaos_drop_lock)"
+                   if self.name in _dropped else ""))
+        others = tuple(h.lock.name for h in held if h.lock is not self._lock)
+        if others:
+            _qt602(f"cond:{self.name}.wait", others,
+                   "condition wait on a different lock")
+        # the real wait releases the real lock: mirror it in the held
+        # stack so guards and hold-time see the truth during the wait
+        held.remove(ent)
+        try:
+            return self._real.wait(timeout)
+        finally:
+            ent.t0 = time.perf_counter()
+            ent.depth = 1
+            held.append(ent)
+
+    def wait_for(self, predicate: Callable[[], Any],
+                 timeout: Optional[float] = None) -> Any:
+        # threading.Condition.wait_for, re-expressed over self.wait so
+        # the explorer's cooperative wait is reused
+        endtime = None
+        waittime = timeout
+        result = predicate()
+        while not result:
+            if waittime is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + waittime
+                else:
+                    waittime = endtime - time.monotonic()
+                    if waittime <= 0:
+                        break
+            self.wait(waittime)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        ctrl = _controller
+        if ctrl is not None and ctrl.controls_current():
+            ctrl.op_notify(self, n)
+            return
+        if self.name in _dropped:
+            return  # a dropped lock never took the real lock: the
+            # mutation under test is lost mutual exclusion, and it is
+            # detected at wait sites -- a notify crash would only mask it
+        self._real.notify(n)
+
+    def notify_all(self) -> None:
+        ctrl = _controller
+        if ctrl is not None and ctrl.controls_current():
+            ctrl.op_notify(self, None)
+            return
+        if self.name in _dropped:
+            return
+        self._real.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<sync.Condition {self.name!r}>"
+
+
+# ---------------------------------------------------------------------------
+# adopt the telemetry registry's lock: telemetry cannot import this
+# module (it sits below everything), so the swap happens here, exactly
+# once, the first time the serving stack pulls the instrumented layer in
+# ---------------------------------------------------------------------------
+
+def _adopt_registry_lock() -> None:
+    from .. import telemetry
+    reg = getattr(telemetry, "REGISTRY", None)
+    if reg is not None and not isinstance(reg._lock, Lock):
+        reg._lock = Lock("telemetry.registry", record=False)
+
+
+_adopt_registry_lock()

@@ -39,9 +39,10 @@ import contextlib
 import os
 import threading
 import time
-from typing import Callable, TypeVar
+from typing import Callable, Iterator, TypeVar
 
 from .. import telemetry
+from . import sync as _sync
 from .errors import QuESTHangError
 
 __all__ = ["ENV_MS", "HANG_SLEEP_S", "deadline_s", "configure",
@@ -58,7 +59,7 @@ HANG_SLEEP_S = 0.1
 _UNSET = object()
 _override: object = _UNSET          # configure()/watchdog_deadline value
 _env_cache: object = _UNSET         # parsed QUEST_WATCHDOG_MS (None = off)
-_lock = threading.Lock()
+_lock = _sync.Lock("watchdog.env")
 
 
 def _qt303(raw: str) -> None:
@@ -115,7 +116,7 @@ def reset() -> None:
 
 
 @contextlib.contextmanager
-def watchdog_deadline(ms: float | None):
+def watchdog_deadline(ms: float | None) -> Iterator[None]:
     """Context manager arming the watchdog at ``ms`` for the block
     (tests/chaos); restores the previous setting on exit."""
     global _override

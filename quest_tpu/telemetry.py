@@ -43,6 +43,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import sys
 import threading
 import time
 
@@ -125,12 +126,25 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def _registry_lock():
+    """The registry's lock from the instrumented sync layer
+    (``resilience.sync``, name ``telemetry.registry``, ``record=False``
+    so recording a metric never records a metric). Telemetry sits below
+    everything, so the layer is probed via sys.modules instead of
+    imported: at bootstrap (sync itself imports telemetry first) this
+    falls back to a raw lock, which sync adopts at ITS import."""
+    sync = sys.modules.get(__name__.rsplit(".", 1)[0] + ".resilience.sync")
+    if sync is not None:
+        return sync.Lock("telemetry.registry", record=False)
+    return threading.Lock()  # concheck: allow-raw-lock (bootstrap only)
+
+
 class MetricsRegistry:
     """Process-global metric store; all module-level helpers delegate to
     one shared instance (:data:`REGISTRY`)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _registry_lock()
         self._local = threading.local()
         self.enabled = _ENV_ENABLED
         self._jsonl_fh = None

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import inspect
 from dataclasses import dataclass
+from typing import TYPE_CHECKING, Iterable
 
 import numpy as np
 
@@ -30,6 +31,9 @@ from ..circuits import Circuit
 from ..engine.params import _SEED, Param
 from ..validation import QuESTError
 from . import noise
+
+if TYPE_CHECKING:
+    from ..environment import QuESTEnv
 
 __all__ = ["unravel", "run_ensemble", "ensemble_density",
            "trajectory_count_default", "TrajectoryResult",
@@ -99,7 +103,8 @@ def _channel_site(name, fn, args, kwargs):
     return "kraus", targets, ops
 
 
-def unravel(circuit: Circuit, seed=None) -> Circuit:
+def unravel(circuit: Circuit,
+            seed: Param | int | None = None) -> Circuit:
     """Rewrite a noisy (typically density-matrix) circuit into its
     trajectory form: every built-in mix* channel and explicit CPTP Kraus
     entry becomes an :func:`noise.applyTrajectoryKraus` site over a pure
@@ -135,7 +140,7 @@ def unravel(circuit: Circuit, seed=None) -> Circuit:
     return out
 
 
-def ensemble_density(states) -> np.ndarray:
+def ensemble_density(states: np.ndarray) -> np.ndarray:
     """The ensemble-mean density matrix (2^n, 2^n complex) of a stack of
     planar trajectory states (T, 2, 2^n) -- the small-n oracle-comparison
     helper; rho[i, j] = mean_t psi_t[i] conj(psi_t[j])."""
@@ -163,9 +168,12 @@ class TrajectoryResult:
 
 
 def run_ensemble(circuit: Circuit, num_trajectories: int | None = None, *,
-                 env=None, seeds=None, base_seed: int = 0, params=None,
+                 env: QuESTEnv | None = None,
+                 seeds: Iterable[int] | None = None, base_seed: int = 0,
+                 params: dict | None = None,
                  max_batch: int | None = None,
-                 precision_code: int | None = None, initial="zero",
+                 precision_code: int | None = None,
+                 initial: object = "zero",
                  timeout: float | None = None) -> TrajectoryResult:
     """Execute a trajectory ensemble of ``circuit`` through the serving
     engine: one Engine per call, T = ``num_trajectories`` (default: the

@@ -18,14 +18,21 @@ from __future__ import annotations
 
 import numpy as np
 
+from typing import TYPE_CHECKING, Iterable, Sequence
+
 from .. import validation as V
 from ..validation import QuESTError
 from .sample import apply_traj_kraus
 
+if TYPE_CHECKING:
+    from ..registers import Qureg
+
 __all__ = ["applyTrajectoryKraus"]
 
 
-def applyTrajectoryKraus(qureg, targets, ops, seed, site: int = 0) -> None:
+def applyTrajectoryKraus(qureg: Qureg, targets: Iterable[int],
+                         ops: Sequence[np.ndarray], seed: object,
+                         site: int = 0) -> None:
     """Sample one Kraus operator of ``ops`` on ``targets`` with the
     trajectory's PRNG stream and apply it renormalised to the state-vector
     ``qureg`` (density registers take the exact channel via mix* instead).

@@ -1,0 +1,433 @@
+"""Concurrency verifier (quest_tpu/analysis/concheck.py +
+quest_tpu/resilience/sync.py, ISSUE 15).
+
+Contracts under test:
+
+- the instrumented primitives are a pass-through when checking is off
+  and record held stacks / order edges / hold metrics when on;
+- QT602 fires on future resolution (and any declared blocking boundary)
+  under an instrumented lock, and stays silent on the clean paths;
+- QT601 detects a constructed two-lock ordering cycle (with the
+  first-occurrence stacks attached) and reports NOTHING over the graph
+  the real serving workload records;
+- the interleaving explorer schedule-completes all three production
+  race scenarios (submit-vs-close, quarantine-failover, hedged
+  dispatch) with zero breaches on clean code, exploring more than one
+  distinct interleaving each -- and every seeded mutation (dropped
+  lock, resolution moved inside the lock, stripped once-resolution
+  guard, skipped drain hand-off) is caught;
+- the QT603 atomicity and QT604 raw-lock AST lints flag the seeded
+  fixtures, honor the allow pragma and the locked-helper call-graph
+  fixpoint, and report nothing over the shipped package.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from quest_tpu import telemetry
+from quest_tpu.analysis import concheck as C
+from quest_tpu.engine import pool as pmod
+from quest_tpu.engine.engine import Engine
+from quest_tpu.engine.pool import EnginePool
+from quest_tpu.resilience import sync as _sync
+from quest_tpu.resilience.errors import QuESTCancelledError
+
+
+@pytest.fixture
+def conchecked():
+    """Checking forced on for one test, prior state restored after."""
+    saved = (_sync._env_read, _sync._active)
+    mark = len(_sync.blocking_findings())
+    _sync.configure(True)
+    yield
+    _sync._env_read, _sync._active = saved
+    del _sync._qt602_list[mark:]
+
+
+@pytest.fixture(scope="module")
+def scenarios():
+    """One warmed instance of each production scenario: the reference
+    results and the compiled executables (global LRU) are shared by
+    every explore() in this module."""
+    out = {}
+    for name, cls in C.SCENARIOS.items():
+        sc = cls()
+        sc.warm()
+        sc.warm = lambda: None  # explore() re-invokes warm; once is enough
+        out[name] = sc
+    return out
+
+
+# ---------------------------------------------------------------------------
+# instrumented primitives
+# ---------------------------------------------------------------------------
+
+def test_sync_passthrough_when_off():
+    saved = (_sync._env_read, _sync._active)
+    _sync.configure(False)
+    try:
+        lk = _sync.Lock("t.passthrough")
+        with lk:
+            assert lk.locked()
+            assert _sync.held_locks() == ()  # nothing recorded when off
+        assert not lk.locked()
+    finally:
+        _sync._env_read, _sync._active = saved
+
+
+def test_sync_held_stack_and_metrics(conchecked):
+    before = telemetry.counter_value("lock_acquisitions_total",
+                                     lock="t.metrics")
+    lk = _sync.Lock("t.metrics")
+    with lk:
+        assert "t.metrics" in _sync.held_locks()
+    assert _sync.held_locks() == ()
+    assert telemetry.counter_value("lock_acquisitions_total",
+                                   lock="t.metrics") == before + 1
+
+
+def test_rlock_reentry_records_single_hold(conchecked):
+    lk = _sync.RLock("t.rlock")
+    with lk:
+        with lk:
+            assert _sync.held_locks().count("t.rlock") == 1
+        assert "t.rlock" in _sync.held_locks()
+    assert _sync.held_locks() == ()
+
+
+def test_qt602_resolve_future_under_lock(conchecked):
+    from concurrent.futures import Future
+
+    mark = len(_sync.blocking_findings())
+    lk = _sync.Lock("t.qt602")
+    fut = Future()
+    with lk:
+        assert _sync.resolve_future(fut, result=7, site="t.under_lock")
+    new = _sync.blocking_findings()[mark:]
+    assert [f.code for f in new] == ["QT602"]
+    assert "t.qt602" in new[0].message and fut.result(0) == 7
+    # clean path: no lock held, no finding, once-guard honored
+    assert not _sync.resolve_future(fut, result=8, site="t.clean")
+    assert _sync.blocking_findings()[mark + 1:] == []
+
+
+def test_qt602_guard_blocking(conchecked):
+    mark = len(_sync.blocking_findings())
+    _sync.guard_blocking("t.free")  # nothing held: silent
+    assert _sync.blocking_findings()[mark:] == []
+    with _sync.Lock("t.guard"):
+        _sync.guard_blocking("t.dispatch")
+    new = _sync.blocking_findings()[mark:]
+    assert [f.code for f in new] == ["QT602"]
+    assert "t.dispatch" in new[0].message
+
+
+def test_qt605_malformed_env_warns_once(monkeypatch):
+    # latch the env read first: counter_value takes the (instrumented)
+    # registry lock, which would otherwise consume the one warning here
+    _sync.configure(False)
+    monkeypatch.setenv(_sync.ENV, "not-a-number")
+    _sync._warned.discard("not-a-number")
+    before = telemetry.counter_value("analysis_findings_total",
+                                     code="QT605", severity="warning")
+    _sync.reset()
+    try:
+        with pytest.warns(RuntimeWarning, match="QUEST_CONCHECK"):
+            assert _sync.checking() is False  # malformed -> default off
+        _sync.reset()
+        assert _sync.checking() is False  # second read: silent (warned set)
+        assert telemetry.counter_value(
+            "analysis_findings_total", code="QT605",
+            severity="warning") == before + 1
+    finally:
+        _sync.reset()
+
+
+# ---------------------------------------------------------------------------
+# QT601 lock-order analysis
+# ---------------------------------------------------------------------------
+
+def _ordered(x, y):
+    with x:
+        with y:
+            pass
+
+
+def test_qt601_two_lock_cycle(conchecked):
+    graph_before = _sync.lock_order_edges()
+    a, b = _sync.Lock("t.cyc_a"), _sync.Lock("t.cyc_b")
+    _ordered(a, b)
+    t = threading.Thread(target=_ordered, args=(b, a))
+    t.start()
+    t.join()
+    fresh = {k: v for k, v in _sync.lock_order_edges().items()
+             if k not in graph_before}
+    findings = C.check_lock_order(fresh, emit=False)
+    assert [f.code for f in findings] == ["QT601"]
+    assert "t.cyc_a -> t.cyc_b -> t.cyc_a" in findings[0].message \
+        or "t.cyc_b -> t.cyc_a -> t.cyc_b" in findings[0].message
+    assert "held while acquiring" in findings[0].message  # stacks attached
+
+
+def test_qt601_consistent_order_is_clean(conchecked):
+    a, b = _sync.Lock("t.ord_a"), _sync.Lock("t.ord_b")
+    graph_before = _sync.lock_order_edges()
+    for _ in range(3):
+        _ordered(a, b)
+    fresh = {k: v for k, v in _sync.lock_order_edges().items()
+             if k not in graph_before}
+    assert fresh  # the edge was recorded...
+    assert C.check_lock_order(fresh, emit=False) == []  # ...and is acyclic
+
+
+# ---------------------------------------------------------------------------
+# interleaving explorer: clean scenarios
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(C.SCENARIOS))
+def test_explorer_scenario_clean(scenarios, name):
+    r = C.InterleavingExplorer(max_schedules=24).explore(scenarios[name])
+    assert r.breaches == []
+    assert r.qt602 == []
+    assert r.schedules > 1 and r.interleavings > 1
+
+
+def test_lock_order_cycle_free_over_workload(scenarios):
+    """The acceptance sweep: the graph accumulated by real explored
+    serving traffic (engines, pool, batchers, drains, hedges) is
+    cycle-free."""
+    _sync.reset_graph()  # drop edges constructed by the QT601 tests
+    C.InterleavingExplorer(max_schedules=8).explore(
+        scenarios["pool_failover_race"])
+    assert C.check_lock_order(emit=False) == []
+
+
+# ---------------------------------------------------------------------------
+# seeded mutations: each must be caught
+# ---------------------------------------------------------------------------
+
+def test_mutation_dropped_lock_detected(scenarios):
+    """Mutation 1: engine.cv made a no-op -- the batcher ends up waiting
+    on a lock it never really acquired; deterministic crash breach."""
+    with _sync.chaos_drop_lock("engine.cv"):
+        r = C.InterleavingExplorer(max_schedules=4).explore(
+            scenarios["engine_close_race"])
+    assert r.breaches
+    assert any("un-acquired" in b and "engine.cv" in b for b in r.breaches)
+
+
+def test_mutation_resolve_inside_lock_detected(scenarios, monkeypatch):
+    """Mutation 2: Engine.close resolving dropped futures INSIDE
+    self._cv -- the round-13 deadlock class -- must surface as QT602."""
+
+    def bad_close(self, drain=True):
+        dropped = []
+        with self._cv:
+            if drain and self._health == "quarantined":
+                drain = False
+            if not drain:
+                while self._q:
+                    dropped.append(self._q.popleft())
+            self._open = False
+            self._cv.notify_all()
+            for req in dropped:  # MUTATION: resolution under the lock
+                _sync.resolve_future(req.fut, exception=QuESTCancelledError(
+                    "request dropped by Engine.close before dispatch",
+                    "Engine.close"), site="engine.close")
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
+            _sync.join_thread(self._thread)
+
+    monkeypatch.setattr(Engine, "close", bad_close)
+    r = C.InterleavingExplorer(max_schedules=32).explore(
+        scenarios["engine_close_race"])
+    assert r.qt602  # some schedule queues the submit before close drops it
+    assert all(f.code == "QT602" for f in r.qt602)
+    assert any("engine.cv" in f.message for f in r.qt602)
+
+
+class _SettleRace:
+    """Two threads race ``EnginePool._settle`` on one request: the
+    deterministic double-resolution probe (clean code resolves the
+    caller's future exactly once in EVERY interleaving)."""
+
+    def setup(self):
+        pool = EnginePool(replicas=1, hedge_ms=0, spawn_replacements=False,
+                          max_batch=2, max_delay_ms=0.0)
+        req = pmod._PoolRequest(None, "fp", None, "default", "normal", None)
+        req.fut = C.CountingFuture()
+        return {"pool": pool, "req": req}
+
+    def threads(self, ctx):
+        pool, req = ctx["pool"], ctx["req"]
+        return [("t0-settle", lambda: pool._settle(req, result=11)),
+                ("t1-settle", lambda: pool._settle(req, result=22))]
+
+    def check(self, ctx):
+        req = ctx["req"]
+        out = []
+        if not req.fut.done():
+            out.append("caller future never resolved")
+        elif req.fut.resolves != 1:
+            out.append(f"caller future resolved {req.fut.resolves}x")
+        return out
+
+    def teardown(self, ctx):
+        ctx["pool"].close(drain=False)
+
+
+def test_mutation_double_resolution_detected(monkeypatch):
+    """Mutation 3: _settle's once-guard stripped -- the losing racer
+    resolves the caller's future a second time, in every schedule."""
+    r = C.InterleavingExplorer(max_schedules=8).explore(_SettleRace())
+    assert r.breaches == []  # clean code: exactly-once in all schedules
+
+    def bad_settle(self, req, result=None, exc=None):
+        with self._cv:
+            req.settled = True  # MUTATION: no already-settled early-out
+            self._cv.notify_all()
+        if exc is not None:
+            req.fut.set_exception(exc)
+        else:
+            req.fut.set_result(result)
+        return True
+
+    monkeypatch.setattr(EnginePool, "_settle", bad_settle)
+    r = C.InterleavingExplorer(max_schedules=8).explore(_SettleRace())
+    assert any("resolved 2x" in b for b in r.breaches)
+    assert any("InvalidStateError" in b for b in r.breaches)
+
+
+def test_mutation_skipped_drain_handoff_detected(scenarios, monkeypatch):
+    """Mutation 4: the quarantine drain pops queued work without
+    resolving it -- the zero-lost-futures contract breaks and some
+    schedule strands the client."""
+
+    def leaky_close(self, drain=True):
+        with self._cv:
+            if drain and self._health == "quarantined":
+                drain = False
+            if not drain:
+                while self._q:
+                    self._q.popleft()  # MUTATION: dropped, never resolved
+            self._open = False
+            self._cv.notify_all()
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
+            _sync.join_thread(self._thread)
+
+    monkeypatch.setattr(Engine, "close", leaky_close)
+    r = C.InterleavingExplorer(max_schedules=24).explore(
+        scenarios["pool_failover_race"])
+    assert r.breaches
+    assert any("never resolved" in b or "deadlock" in b or "lost" in b
+               for b in r.breaches)
+
+
+def test_closed_engine_dispatch_fails_over(scenarios):
+    """Regression for the race the explorer found: a dispatch landing on
+    a drain-closed engine must fail over (reason="closed"), not settle
+    the caller with an untyped RuntimeError. Deterministic replay: close
+    the engine between routing and submit."""
+    sc = scenarios["pool_failover_race"]
+    pool = EnginePool(replicas=2, spawn_replacements=False, hedge_ms=0,
+                      max_batch=2, max_delay_ms=0.0)
+    try:
+        fp = sc.circ.fingerprint()
+        for rep in pool._replicas:
+            pool._engine_for(rep, fp, sc.circ)
+        with pool._cv:
+            pool._manifest.setdefault(fp, sc.circ)
+        # close replica 0's engine as the drain would, then dispatch to it
+        pool._replicas[0].engines[fp].close(drain=False)
+        before = telemetry.counter_value("pool_failovers_total",
+                                         reason="closed")
+        req = pmod._PoolRequest(sc.circ, fp, dict(C._PARAMS_A), "default",
+                                "normal", None)
+        pool._dispatch_attempt(req, pool._replicas[0])
+        got = req.fut.result(timeout=120)
+        assert np.array_equal(np.asarray(got), sc.expected["a"])
+        assert telemetry.counter_value("pool_failovers_total",
+                                       reason="closed") == before + 1
+    finally:
+        pool.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# QT603/QT604 AST lints
+# ---------------------------------------------------------------------------
+
+_RAW_LOCK_FIXTURE = '''\
+import threading
+from threading import Lock as TLock
+
+GOOD = threading.Lock()  # concheck: allow-raw-lock (fixture exception)
+
+class Queueish:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._other = TLock()
+'''
+
+_ATOMICITY_FIXTURE = '''\
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+        self.hits = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+        self.hits += 1          # QT603: n is locked elsewhere, hits is
+                                # only ever bare -- but n also appears
+                                # bare below
+
+    def sloppy(self):
+        self.n += 1             # QT603: bare mutation of a locked field
+
+    def _locked_helper(self):
+        self.n += 1             # fine: every caller holds the lock
+
+    def guarded(self):
+        with self._lock:
+            self._locked_helper()
+
+    def also_guarded(self):
+        with self._lock:
+            self._locked_helper()
+'''
+
+
+def test_qt604_raw_lock_fixture(tmp_path):
+    p = tmp_path / "rawlocks.py"
+    p.write_text(_RAW_LOCK_FIXTURE)
+    findings = C.lint_concurrency([str(p)], emit=False)
+    qt604 = [f for f in findings if f.code == "QT604"]
+    # three raw constructions flagged; the pragma line is exempt
+    assert len(qt604) == 3
+    assert all("allow-raw-lock" in f.hint for f in qt604)
+    assert not any(":4" in f.location for f in qt604)  # the pragma line
+
+
+def test_qt603_atomicity_fixture(tmp_path):
+    p = tmp_path / "atomicity.py"
+    p.write_text(_ATOMICITY_FIXTURE)
+    findings = C.lint_concurrency([str(p)], emit=False)
+    qt603 = {f.message.split(" is mutated")[0]
+             for f in findings if f.code == "QT603"}
+    # n: mixed locked/bare -> flagged; hits: bare-only -> clean;
+    # _locked_helper's mutation: locked via the call-graph fixpoint
+    assert qt603 == {"Counter.n"}
+
+
+def test_lint_clean_over_package():
+    """The shipped package carries no QT6xx lint debt: every serving
+    lock is on the instrumented layer (or pragma'd with a reason) and no
+    lock-owning class mixes locked and bare field mutations."""
+    assert C.lint_concurrency(emit=False) == []

@@ -2,7 +2,7 @@
 """Static-analysis CLI: run the plan verifier / ring checker / tape
 linter (quest_tpu.analysis, docs/analysis.md) from the command line.
 
-Three targets, one finding stream:
+Four targets, one finding stream:
 
   python tools/lint.py --bench-plans [--format json]
       Verify every bench.py --smoke plan config (plan_20q_relocation,
@@ -19,6 +19,14 @@ Three targets, one finding stream:
       Lint a Circuit from python: ``attr`` may be a Circuit, a callable
       returning one (or a list of them), or omitted -- then every
       module-level Circuit is linted.
+
+  python tools/lint.py --concurrency [PATHS...]
+      Run the QT6xx concurrency lints (quest_tpu.analysis.concheck)
+      over the given files/directories (default: the whole quest_tpu
+      package): QT603 fields of a lock-owning class mutated both with
+      and without the lock, QT604 raw threading primitives in code that
+      must use the instrumented quest_tpu.resilience.sync layer. This
+      is what the CI native gate runs.
 
 Exit status 1 when any error-severity finding is reported (the CI gate
 contract); warnings/info exit 0. ``--format json`` prints the
@@ -169,6 +177,10 @@ def main(argv=None) -> int:
                      help="lint an OPENQASM 2 file")
     tgt.add_argument("--module", metavar="MOD[:ATTR]",
                      help="lint Circuit(s) from a python module")
+    tgt.add_argument("--concurrency", nargs="*", metavar="PATH",
+                     default=None,
+                     help="run the QT603/QT604 concurrency lints over "
+                          "PATHS (default: the quest_tpu package)")
     args = ap.parse_args(argv)
 
     _bootstrap_env(args.bench_plans)
@@ -181,6 +193,8 @@ def main(argv=None) -> int:
         import bench
         for spec in bench.smoke_plan_specs():
             findings += A.check_smoke_spec(spec)
+    elif args.concurrency is not None:
+        findings = A.lint_concurrency(args.concurrency or None)
     elif args.qasm:
         findings = _lint_circuit_fully(read_qasm(args.qasm),
                                        os.path.basename(args.qasm))
