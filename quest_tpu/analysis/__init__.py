@@ -17,7 +17,10 @@ concurrency):
   :class:`~.concheck.InterleavingExplorer` (schedule-complete racing of
   submit/close, quarantine-failover, and hedged dispatch), and the
   QT603/QT604 atomicity + raw-lock AST lints
-  (``tools/lint.py --concurrency``).
+  (``tools/lint.py --concurrency``),
+- :mod:`.tracecheck` -- request-trace integrity (QT702 open spans in
+  finished traces, QT703 trace contexts leaked across pooled-thread
+  reuse; ``tools/lint.py --trace FILE``).
 
 Reachable three ways: the ``tools/lint.py`` CLI, the pytest suites, and
 ``QUEST_VERIFY=1`` runtime gating -- :func:`verify_plan` runs at
@@ -44,6 +47,7 @@ from .plancheck import (check_circuit_comm, check_plan, check_schedule,
                         check_tape)
 from .ringcheck import check_events, check_ring, ring_events, sweep_reachable
 from .tapelint import lint_circuit, lint_events, lint_tape
+from .tracecheck import check_live_traces, check_trace_file, check_traces
 
 __all__ = [
     "Finding", "AnalysisError", "CATALOG", "SEVERITIES",
@@ -57,6 +61,7 @@ __all__ = [
     "check_lock_order", "InterleavingExplorer", "ExplorationResult",
     "await_future", "CountingFuture", "SCENARIOS", "run_scenario",
     "lint_concurrency", "check_raw_locks", "check_atomicity",
+    "check_traces", "check_live_traces", "check_trace_file",
     "verify_enabled", "verify_plan", "check_smoke_spec",
 ]
 

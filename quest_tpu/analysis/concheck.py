@@ -18,8 +18,12 @@ over the instrumented primitives of :mod:`quest_tpu.resilience.sync`:
   controller, parks every controlled thread at each sync operation
   (lock acquire/release, condition wait/notify, thread join, and
   :func:`await_future`), and replays the scenario under systematically
-  varied schedules -- depth-first over the recorded choice points,
-  deduplicated by trace fingerprint, bounded by ``max_schedules`` and
+  varied schedules on two interleaved layers -- fresh-seed restarts
+  whose per-schedule thread priorities each impose a different
+  macro-ordering (the PCT idea: some seed starves each thread across a
+  whole race window), alternating with branch flips over the recorded
+  choice points of earlier runs (shallowest first) -- deduplicated by
+  trace fingerprint, bounded by ``max_schedules`` and
   ``max_steps``. A schedule where no parked thread is runnable while a
   scenario thread is unfinished is a **deadlock breach**; a controlled
   thread crashing is a breach; every scenario's own invariant check
@@ -172,12 +176,24 @@ class _TState:
         self.scenario = scenario      # scenario-owned (vs adopted) thread
 
 
+def _prio(seed: int, ordinal: int) -> int:
+    """Deterministic per-(schedule, thread) priority: an integer hash
+    mix, so each seed induces a near-uniform random ordering over the
+    registered threads. No RNG state -- replays are exact."""
+    h = (ordinal * 2654435761 + seed * 0x9E3779B9 + 0x7F4A7C15) \
+        & 0xFFFFFFFF
+    h ^= h >> 16
+    h = (h * 0x45D9F3B) & 0xFFFFFFFF
+    return h ^ (h >> 16)
+
+
 class _Run:
     """Per-schedule state: registered threads, cooperative waiters, the
     decision trail, and the breaches this schedule produced."""
 
-    def __init__(self, prefix: Tuple[int, ...]) -> None:
+    def __init__(self, prefix: Tuple[int, ...], seed: int = 0) -> None:
         self.prefix = prefix
+        self.seed = seed                 # per-schedule priority seed
         self.reglock = threading.Lock()  # concheck: allow-raw-lock
         self.states: dict = {}           # Thread -> _TState
         self.owners: dict = {}           # lock object -> [state, depth]
@@ -297,12 +313,18 @@ class InterleavingExplorer:
             if not self._park(run, st, ("acquire", lock.name),
                               self._acquire_elig(run, st, lock)):
                 return lock.acquire(blocking, timeout)  # detached
-            if _sync._acquire_checked(lock, False, -1):
+            # the grant can race an UNCONTROLLED holder of the real lock
+            # (a free-running thread from outside the schedule); wait it
+            # out briefly instead of re-parking, so a loaded machine's
+            # longer hold windows don't burn the schedule's step budget
+            # on retries. Controlled threads are all parked at this
+            # point, so the short block cannot reorder the schedule.
+            if _sync._acquire_checked(lock, True, 0.05):
                 st.holds.append(lock)
                 own = run.owners.setdefault(lock, [st, 0])
                 own[1] += 1
                 return True
-            # the grant raced an uncontrolled holder: yield again
+            # still held past the grace window: yield again
 
     def op_release(self, lock) -> None:
         run, st = self._current()
@@ -351,7 +373,8 @@ class InterleavingExplorer:
                               self._acquire_elig(run, st, lock)):
                 lock.acquire()
                 return token.notified
-            if _sync._acquire_checked(lock, False, -1):
+            # same uncontrolled-holder grace window as op_acquire
+            if _sync._acquire_checked(lock, True, 0.05):
                 st.holds.append(lock)
                 own = run.owners.setdefault(lock, [st, 0])
                 own[1] += 1
@@ -451,9 +474,14 @@ class InterleavingExplorer:
         st.parked = op
         run.sched_evt.set()
         st.gate.wait()
-        st.gate.clear()
+        # clear parked BEFORE the gate: while the gate is set the
+        # scheduler counts this thread as busy (grant pending), and once
+        # the gate clears parked is already None -- there is no window
+        # where a consumed park still looks grantable, so a slow wakeup
+        # (loaded box, 1 CPU) cannot be re-granted and burn steps
         st.parked = None
         st.eligible = None
+        st.gate.clear()
         return not run.detached
 
     def _register(self, run: _Run, t: threading.Thread,
@@ -477,8 +505,14 @@ class InterleavingExplorer:
         deadline = time.monotonic() + self.stall_s
         while True:
             run.sched_evt.clear()
+            # a set gate means a grant is pending consumption: the thread
+            # was woken but has not run yet -- it is busy, not parked
+            # (re-granting it would be a free no-op step, and a scheduler
+            # hot loop here can burn the whole step budget before the
+            # woken thread ever gets CPU time on a saturated machine)
             busy = [s for s in run.snapshot()
-                    if not s.finished and s.parked is None]
+                    if not s.finished
+                    and (s.parked is None or s.gate.is_set())]
             if not busy:
                 return True
             if time.monotonic() > deadline:
@@ -509,10 +543,28 @@ class InterleavingExplorer:
                 return
             if len(eligible) > 1:
                 d = len(run.taken)
-                want = run.prefix[d] if d < len(run.prefix) else 0
-                if want >= len(eligible):
-                    want = 0
-                    run.diverged = True
+                if d < len(run.prefix):
+                    want = run.prefix[d]
+                    if want >= len(eligible):
+                        want = 0
+                        run.diverged = True
+                else:
+                    # beyond the replayed prefix, the default choice is
+                    # the thread with the highest seeded priority -- NOT
+                    # a fixed sort position. A fixed default makes the
+                    # alphabetically-first thread (an engine batcher) win
+                    # every branch, so the default schedule drains queues
+                    # instantly and any race that needs the consumer
+                    # starved across a window (quarantine landing on a
+                    # queued request) hides behind a long all-non-default
+                    # prefix the DFS budget never builds. Per-schedule
+                    # priorities (the PCT insight) starve each thread for
+                    # whole windows in SOME schedule while every choice
+                    # stays a pure function of (seed, ordinal): replays
+                    # and recorded prefixes are unaffected.
+                    want = max(range(len(eligible)),
+                               key=lambda i: _prio(run.seed,
+                                                   eligible[i].ordinal))
                 run.alts.append(len(eligible))
                 run.taken.append(want)
                 chosen = eligible[want]
@@ -531,9 +583,9 @@ class InterleavingExplorer:
         for st in run.snapshot():
             st.gate.set()
 
-    def _run_schedule(self, scenario,
-                      prefix: Tuple[int, ...]) -> Tuple[_Run, list]:
-        run = _Run(prefix)
+    def _run_schedule(self, scenario, prefix: Tuple[int, ...],
+                      seed: int = 0) -> Tuple[_Run, list]:
+        run = _Run(prefix, seed)
         qt602_mark = len(_sync.blocking_findings())
         ctx = None
         owned: List[threading.Thread] = []
@@ -626,12 +678,22 @@ class InterleavingExplorer:
             warm = getattr(scenario, "warm", None)
             if warm is not None:
                 warm()
-            frontier: List[Tuple[int, ...]] = [()]
+            frontier: List[Tuple[int, ...]] = []
             visited = {()}
             traces: set = set()
-            while frontier and result.schedules < self.max_schedules:
-                prefix = frontier.pop()
-                run, qt602 = self._run_schedule(scenario, prefix)
+            while result.schedules < self.max_schedules:
+                k = result.schedules
+                # two interleaved exploration layers: even schedules
+                # restart from an EMPTY prefix under a fresh priority
+                # seed (each seed is a whole different macro-ordering --
+                # some starve the consumer through the race window, some
+                # run the killer first, some the client); odd schedules
+                # refine recorded runs by flipping one branch. Seeds
+                # alone miss fine interleavings, branch flips alone pin
+                # ever-longer prefixes that freeze the macro-ordering.
+                prefix = frontier.pop() if (k % 2 == 1 and frontier) \
+                    else ()
+                run, qt602 = self._run_schedule(scenario, prefix, k)
                 result.schedules += 1
                 result.qt602.extend(qt602)
                 result.breaches.extend(
@@ -641,7 +703,13 @@ class InterleavingExplorer:
                     result.truncated += 1
                 traces.add(tuple(run.trace))
                 if not run.diverged:
-                    for d in range(len(prefix), len(run.alts)):
+                    # deepest alternatives first, so the LIFO frontier
+                    # pops the SHALLOWEST flip next: early choices set
+                    # the macro-ordering (who wins the race window), and
+                    # pinning a near-complete prefix would freeze every
+                    # schedule into the same trace with only tail noise
+                    # -- the per-seed priorities would never get to act
+                    for d in reversed(range(len(prefix), len(run.alts))):
                         for j in range(1, run.alts[d]):
                             p = tuple(run.taken[:d]) + (j,)
                             if p not in visited:
@@ -854,7 +922,7 @@ class HedgeRaceScenario(_ScenarioBase):
         def primary() -> None:
             pool._dispatch_attempt(req, ctx["rep0"])
             with pool._cv:
-                inner = [f for (_r, f, h) in req.inner if not h]
+                inner = [f for (_r, f, h, _sp) in req.inner if not h]
             try:
                 if inner:
                     await_future(inner[0])
@@ -870,7 +938,7 @@ class HedgeRaceScenario(_ScenarioBase):
                 req.hedged = True
             pool._issue_hedge(req, ctx["rep1"])
             with pool._cv:
-                inner = [f for (_r, f, h) in req.inner if h]
+                inner = [f for (_r, f, h, _sp) in req.inner if h]
             try:
                 if inner:
                     await_future(inner[0])

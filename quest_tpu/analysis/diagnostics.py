@@ -18,7 +18,11 @@ reports :class:`Finding` records drawn from one code catalog:
   deadlock cycles, locks held across blocking boundaries / future
   resolution, atomicity and raw-lock lints --
   :mod:`quest_tpu.analysis.concheck` over
-  :mod:`quest_tpu.resilience.sync`, docs/analysis.md).
+  :mod:`quest_tpu.resilience.sync`, docs/analysis.md),
+- ``QT7xx`` -- request-tracing hygiene (malformed ``QUEST_TRACE``, spans
+  left open at export, trace contexts leaked across pooled-thread reuse
+  -- :mod:`quest_tpu.analysis.tracecheck` over
+  :mod:`quest_tpu.telemetry`, docs/observability.md).
 
 Each finding carries a severity (``error`` | ``warning`` | ``info``), a
 human-readable location and a one-line fix hint. :func:`emit_findings`
@@ -247,6 +251,21 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "set QUEST_CONCHECK to 0 (off, the default) or an integer "
               ">= 1 to enable the instrumented sync layer; the "
               "malformed value was replaced"),
+    # -- QT7xx: request-tracing hygiene (analysis/tracecheck.py) ------------
+    "QT701": ("warning", "malformed QUEST_TRACE value; tracing stays off",
+              "set QUEST_TRACE to off, errors, all, or a head-sampling "
+              "rate in (0, 1) (e.g. 0.01); the malformed value warns "
+              "once per process and tracing remains disabled"),
+    "QT702": ("warning", "trace span opened but never closed at export",
+              "every TraceContext.child() must be end()-ed on all paths "
+              "(success, error, cancellation) before the layer that "
+              "minted the trace calls finish_trace; an open span at "
+              "export means a hop's error path dropped its handle"),
+    "QT703": ("error", "trace context leaked across pooled-thread reuse",
+              "a worker thread still holds finished trace context(s): "
+              "pair every set_current_trace with clear_current_trace "
+              "after future resolution, or the next request dispatched "
+              "on that thread inherits a dead trace"),
 }
 
 

@@ -1,0 +1,82 @@
+"""Trace integrity checks for the request-tracing subsystem (QT70x).
+
+Two invariants guard the round-17 span trees
+(:mod:`quest_tpu.telemetry`):
+
+- **QT702 -- span opened but never closed**: a finished trace whose span
+  list still carries an open entry (``dur_ms is None``). Every
+  :meth:`~quest_tpu.telemetry.TraceContext.child` must be ``end()``-ed
+  before the layer that minted the root finishes it; an open span at
+  export time means an instrumentation site leaked a handle (typically
+  an early return between ``child()`` and ``end()``), and the Perfetto
+  waterfall for that request renders a span of unknown extent.
+- **QT703 -- trace context leaked across pooled-thread reuse**: a
+  batcher/callback thread still bound (via
+  :func:`~quest_tpu.telemetry.set_current_trace`) to contexts whose
+  traces have ALL finished. The next request dispatched on that thread
+  would be adopted into a dead trace -- cross-request attribution, the
+  tracing analogue of the QT603 torn-state lint. Dispatch loops must
+  pair every bind with :func:`~quest_tpu.telemetry.clear_current_trace`.
+
+Reachable three ways, like every checker in this package: the
+``tools/lint.py --trace FILE`` CLI (over an
+:func:`~quest_tpu.telemetry.export_traces` file), the pytest suite, and
+the dryrun trace-smoke (``__graft_entry__`` runs
+:func:`check_live_traces` before exporting). See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .diagnostics import Finding, make_finding
+
+__all__ = ["check_traces", "check_live_traces", "check_trace_file"]
+
+
+def check_traces(trs, location: str = "traces") -> list:
+    """QT702 over finished trace dicts (:func:`quest_tpu.telemetry.traces`
+    or the ``traces`` list of an ``export_traces`` file): one finding per
+    trace that retains at least one open span, naming the spans."""
+    findings: list[Finding] = []
+    for tr in trs:
+        open_spans = [sp for sp in tr.get("spans", ())
+                      if sp.get("dur_ms") is None]
+        if open_spans:
+            names = ", ".join(
+                f"{sp.get('id')}:{sp.get('name')}" for sp in open_spans[:5])
+            more = len(open_spans) - 5
+            findings.append(make_finding(
+                "QT702",
+                f"trace {tr.get('trace_id')} finished with "
+                f"{len(open_spans)} open span(s): {names}"
+                + (f" (+{more} more)" if more > 0 else ""),
+                f"{location}.{tr.get('trace_id')}"))
+    return findings
+
+
+def check_live_traces(location: str = "telemetry") -> list:
+    """QT702 + QT703 over the LIVE registry: retained finished traces plus
+    the thread-binding table (:func:`~quest_tpu.telemetry
+    .trace_thread_leaks`). The dryrun trace-smoke and the pool/engine
+    teardown tests call this after the fleet quiesces."""
+    from .. import telemetry
+    findings = check_traces(telemetry.traces(), location=location)
+    for tname, trace_id in telemetry.trace_thread_leaks():
+        findings.append(make_finding(
+            "QT703",
+            f"thread {tname!r} is still bound to finished trace "
+            f"{trace_id}: the next request dispatched there would be "
+            f"adopted into a dead trace (missing clear_current_trace)",
+            f"{location}.{tname}"))
+    return findings
+
+
+def check_trace_file(path: str, location: str | None = None) -> list:
+    """QT702 over an :func:`~quest_tpu.telemetry.export_traces` JSON file
+    (``{"traces": [...]}``; a bare list is accepted too) -- the
+    ``tools/lint.py --trace`` entry point."""
+    with open(path) as f:
+        doc = json.load(f)
+    trs = doc.get("traces", []) if isinstance(doc, dict) else doc
+    return check_traces(trs, location=location or path)

@@ -163,6 +163,12 @@ class AdmissionController:
             # the engine-level series too, so one dashboard family shows
             # every shed request regardless of which layer shed it
             telemetry.inc("engine_backpressure_total", reason="quota")
+            if telemetry.trace_on():
+                # a quota shed shows as an instant on any trace the
+                # calling thread is already working for (nested serving)
+                telemetry.trace_event_current(
+                    "admission.reject", tenant=tenant, priority=priority,
+                    n=n)
             raise QuESTBackpressureError(
                 f"tenant {tenant!r} is over its admission quota "
                 f"({b.rate:g} req/s, burst {b.burst:g}): rejecting {n} "

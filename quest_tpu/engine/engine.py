@@ -110,18 +110,21 @@ _HEAL_STREAK = 3
 
 class _Request:
     """One queued parameter set: bound values, the caller's future, the
-    enqueue timestamp, an optional wall-clock deadline, and the injected
-    poison kind pinned at submit time (None on healthy requests)."""
+    enqueue timestamp, an optional wall-clock deadline, the injected
+    poison kind pinned at submit time (None on healthy requests), and the
+    request's trace context (None whenever tracing is off)."""
 
-    __slots__ = ("values", "fut", "t0", "deadline", "poison")
+    __slots__ = ("values", "fut", "t0", "deadline", "poison", "trace")
 
     def __init__(self, values: tuple, fut: Future, t0: float,
-                 deadline: float | None, poison: str | None):
+                 deadline: float | None, poison: str | None,
+                 trace=None):
         self.values = values
         self.fut = fut
         self.t0 = t0
         self.deadline = deadline
         self.poison = poison
+        self.trace = trace
 
 
 def _env_queue_max() -> int:
@@ -216,6 +219,7 @@ class Engine:
         self._breaches = 0        # sentinel breaches since last full heal
         self._clean_streak = 0    # consecutive clean dispatches
         self._dispatches = 0      # dispatch ordinal = the sentinel tick
+        self._t_first: float | None = None  # batcher pop instant (tracing)
         self._thread = threading.Thread(target=self._loop,
                                         name="quest-engine", daemon=True)
         self._thread.start()
@@ -281,6 +285,12 @@ class Engine:
                     f"{len(values_list)} request(s)", "Engine.submit")
             now = time.perf_counter()
             deadline = None if timeout is None else now + timeout
+            # tracing (round 17): one boolean read when off. A pool-side
+            # attempt span bound to this thread is adopted as the parent
+            # (the request stays ONE waterfall across the hop); otherwise
+            # the engine mints the root and owns finishing it.
+            tracing = telemetry.trace_on()
+            adopt = telemetry.current_trace() if tracing else None
             for values in values_list:
                 fut = Future()
                 # injected poison pins to the REQUEST here, at submit time,
@@ -288,7 +298,17 @@ class Engine:
                 # how the batcher later coalesces or bisects
                 poison = _faults.fire("engine.request") \
                     if _faults.enabled() else None
-                self._q.append(_Request(values, fut, now, deadline, poison))
+                if not tracing:
+                    ctx = None
+                elif adopt is not None and len(values_list) == 1:
+                    ctx = adopt.child("engine.request",
+                                      engine=self.fingerprint[:8])
+                else:
+                    ctx = telemetry.start_trace(
+                        "request", t0=now, kind="engine",
+                        engine=self.fingerprint[:8])
+                self._q.append(
+                    _Request(values, fut, now, deadline, poison, ctx))
                 futs.append(fut)
             telemetry.inc("engine_requests_total", len(futs))
             telemetry.set_gauge("engine_queue_depth", len(self._q))
@@ -402,9 +422,11 @@ class Engine:
             # no-op on futures a waiter already holds in RUNNING
             # transitions elsewhere, and CancelledError carries no
             # context -- this names the drop
-            _sync.resolve_future(req.fut, exception=QuESTCancelledError(
+            exc = QuESTCancelledError(
                 "request dropped by Engine.close before dispatch",
-                "Engine.close"), site="engine.close")
+                "Engine.close")
+            self._trace_error(req, exc)
+            _sync.resolve_future(req.fut, exception=exc, site="engine.close")
         if self._thread.is_alive() and \
                 self._thread is not threading.current_thread():
             _sync.join_thread(self._thread)
@@ -481,6 +503,12 @@ class Engine:
                 telemetry.set_gauge("engine_queue_depth", len(self._q))
             live = self._expire(batch)
             if live:
+                # t_first (the pop instant) is recovered from the already
+                # taken deadline reading: queue_wait/coalesce attribution
+                # costs the untraced path zero extra clock reads. Handed
+                # over on the instance so _dispatch keeps its one-argument
+                # seam (tests wrap it with lambda b: ...).
+                self._t_first = deadline - self.max_delay_s
                 self._dispatch(live)
 
     def _expire(self, batch: list) -> list:
@@ -491,11 +519,14 @@ class Engine:
         for req in batch:
             if req.deadline is not None and now >= req.deadline:
                 telemetry.inc("engine_request_timeouts_total")
-                _sync.resolve_future(req.fut, exception=QuESTTimeoutError(
+                exc = QuESTTimeoutError(
                     f"request deadline expired after "
                     f"{now - req.t0:.3f}s in queue "
                     f"(timeout={req.deadline - req.t0:.3f}s)",
-                    "Engine.submit"), site="engine.expire")
+                    "Engine.submit")
+                self._trace_error(req, exc)
+                _sync.resolve_future(req.fut, exception=exc,
+                                     site="engine.expire")
             else:
                 live.append(req)
         return live
@@ -513,10 +544,27 @@ class Engine:
                            and self._lifted.slots) else "sequential")
 
     def _dispatch(self, batch: list) -> None:
+        t_first = self._t_first
         mode = self._mode()
         self._dispatches += 1
         telemetry.inc("engine_batches_total", mode=mode)
         telemetry.observe("engine_batch_size", len(batch))
+        # tracing (round 17): attribute queue_wait (enqueue -> batcher
+        # pop) and coalesce (pop -> window close) per request, then bind
+        # the batch's contexts to this thread so retry/guard/bisect hops
+        # inside the dispatch can link to them. The binding MUST clear
+        # after the futures resolve (QT703) -- the finally below.
+        traced = [r.trace for r in batch if r.trace is not None]
+        if traced:
+            t_close = time.perf_counter()
+            for req in batch:
+                tr = req.trace
+                if tr is None:
+                    continue
+                pivot = req.t0 if t_first is None else max(req.t0, t_first)
+                tr.phase("queue_wait", req.t0, max(0.0, pivot - req.t0))
+                tr.phase("coalesce", pivot, max(0.0, t_close - pivot))
+            telemetry.set_current_trace(traced)
         # the injectable hang point: one visit per dispatch; with
         # QUEST_WATCHDOG_MS armed the WHOLE dispatch (tracing included --
         # it begins and ends on the watchdog's worker thread, so jax's
@@ -534,6 +582,7 @@ class Engine:
             # fail the batch typed and quarantine the engine
             self._note_breach(hang=True)
             for req in batch:
+                self._trace_error(req, e)
                 _sync.resolve_future(req.fut, exception=e,
                                      site="engine.dispatch")
         except QuESTIntegrityError as e:
@@ -541,6 +590,7 @@ class Engine:
             # it: fail the remainder typed, degrade (quarantine on repeat)
             self._note_breach(hang=False)
             for req in batch:
+                self._trace_error(req, e)
                 _sync.resolve_future(req.fut, exception=e,
                                      site="engine.dispatch")
         except Exception:
@@ -550,10 +600,14 @@ class Engine:
             self._bisect(batch, mode)
         except BaseException as e:  # interpreter teardown must not hang waiters
             for req in batch:
+                self._trace_error(req, e)
                 _sync.resolve_future(req.fut, exception=e,
                                      site="engine.dispatch")
         else:
             self._note_clean()
+        finally:
+            if traced:
+                telemetry.clear_current_trace()
         now = time.perf_counter()
         for req in batch:
             telemetry.observe("engine_request_latency_seconds", now - req.t0)
@@ -567,7 +621,7 @@ class Engine:
         else:
             self._dispatch_sequential(batch)
 
-    def _bisect(self, batch: list, mode: str) -> None:
+    def _bisect(self, batch: list, mode: str, _prev: dict | None = None) -> None:
         telemetry.inc("engine_bisections_total")
         if len(batch) == 1:
             req = batch[0]
@@ -576,15 +630,32 @@ class Engine:
             except BaseException as e:
                 if req.poison is not None:
                     telemetry.inc("engine_poisoned_requests_total")
+                self._trace_error(req, e)
                 _sync.resolve_future(req.fut, exception=e,
                                      site="engine.bisect")
             return
         mid = len(batch) // 2
         for half in (batch[:mid], batch[mid:]):
+            # each bisection level gets one span per traced request,
+            # linked to the request's previous (failed) level so the
+            # waterfall shows the isolation search (round 17)
+            spans: dict = {}
+            for r in half:
+                if r.trace is not None:
+                    sp = r.trace.child("engine.bisect", size=len(half))
+                    prev = None if _prev is None else _prev.get(id(r))
+                    sp.link(prev if prev is not None else r.trace,
+                            kind="bisect")
+                    spans[id(r)] = sp
             try:
                 self._dispatch_one(half, mode)
             except BaseException:
-                self._bisect(half, mode)
+                for sp in spans.values():
+                    sp.end(status="error")
+                self._bisect(half, mode, _prev=spans)
+            else:
+                for sp in spans.values():
+                    sp.end()
 
     def _sentinel_gate(self, amps) -> None:
         """Check one dispatch result against the armed sentinel policy
@@ -612,17 +683,92 @@ class Engine:
         from ..resilience import guard as _guard
         return _guard.corrupt_amps(amps)
 
+    def _trace_done(self, req, rt0: float, rt1: float) -> None:
+        """Record the resolve phase; finish engine-owned traces (adopted
+        pool children only close their span -- the pool's settle owns
+        finishing the root)."""
+        tr = req.trace
+        if tr is None:
+            return
+        tr.phase("resolve", rt0, rt1 - rt0)
+        if tr.owns_root:
+            telemetry.finish_trace(tr)
+        else:
+            tr.end()
+
+    def _trace_error(self, req, exc) -> None:
+        """Mark a request's trace failed: errored traces are ALWAYS
+        retained (the QUEST_TRACE=errors contract), so every resolve-with-
+        exception site pairs with this."""
+        tr = req.trace
+        if tr is None:
+            return
+        if tr.owns_root:
+            telemetry.finish_trace(tr, error=type(exc).__name__)
+        else:
+            tr.event("error", type=type(exc).__name__)
+            tr.end(status="error")
+
+    def _traced_replay(self, req, x, t_start):
+        """One per-request replay with compile/dispatch/device phase
+        attribution: the retrace-counter delta decides whether the call
+        paid a compile, and an explicit block_until_ready (the device
+        phase) separates dispatch from device drain. The launch phase
+        starts at the caller-supplied ``t_start`` and the device-sync
+        timestamp is returned so consecutive phase windows tile exactly
+        (bookkeeping such as the counter reads lands inside a phase, not
+        between two). Tracing-armed requests only -- the untraced path
+        never blocks."""
+        import jax
+
+        before = telemetry.counter_value("engine_trace_total",
+                                         kind="param_replay")
+        res = self._maybe_corrupt(
+            x.with_values(self.initial_amps + 0, req.values))
+        t_d = time.perf_counter()
+        jax.block_until_ready(res)
+        t_e = time.perf_counter()
+        retraced = telemetry.counter_value(
+            "engine_trace_total", kind="param_replay") > before
+        req.trace.phase("compile" if retraced else "dispatch",
+                        t_start, t_d - t_start)
+        req.trace.phase("device", t_d, t_e - t_d)
+        return res, t_e
+
     def _dispatch_sequential(self, batch: list) -> None:
+        tracing = any(req.trace is not None for req in batch)
+        t_a = time.perf_counter() if tracing else 0.0
         x = self._exec1()
+        if tracing:
+            t_b = time.perf_counter()
+            for req in batch:
+                if req.trace is not None:
+                    req.trace.phase("cache_lookup", t_a, t_b - t_a)
         for req in batch:
             if req.poison is not None:
                 raise PoisonedRequestFault("engine.request", req.poison)
             # one param-replay program launch per request (host-side
             # count: inside the program it would count traces)
             telemetry.inc("device_dispatch_total", route="engine_param")
-            res = self._maybe_corrupt(
-                x.with_values(self.initial_amps + 0, req.values))
+            if req.trace is None:
+                res = self._maybe_corrupt(
+                    x.with_values(self.initial_amps + 0, req.values))
+                self._sentinel_gate(res)
+                _sync.resolve_future(req.fut, result=res,
+                                     site="engine.dispatch")
+                continue
+            # sequential replays are serial: time spent on earlier batch
+            # mates is this request's in-batch queueing
+            t_i = time.perf_counter()
+            if t_i > t_b:
+                req.trace.phase("queue_wait", t_b, t_i - t_b)
+            res, t_e = self._traced_replay(req, x, t_i)
             self._sentinel_gate(res)
+            # trace bookkeeping BEFORE the resolution: a woken waiter
+            # must observe its trace already finished (the pool's settle
+            # callback runs inside resolve_future and copies the phase
+            # vector when it closes the root)
+            self._trace_done(req, t_e, time.perf_counter())
             _sync.resolve_future(req.fut, result=res,
                                  site="engine.dispatch")
 
@@ -635,26 +781,83 @@ class Engine:
             # device-rejected lane) -- _bisect isolates it
             if req.poison is not None:
                 raise PoisonedRequestFault("engine.request", req.poison)
+        traced = [req for req in batch if req.trace is not None]
         if not self._lifted.slots:
             # value-free structure: every request computes the same state
             telemetry.inc("device_dispatch_total", route="engine_param")
-            out = self._maybe_corrupt(
-                self._exec1().with_values(self.initial_amps + 0, ()))
+            t_a = time.perf_counter() if traced else 0.0
+            x = self._exec1()
+            if traced:
+                import jax
+
+                t_b = time.perf_counter()
+                before = telemetry.counter_value("engine_trace_total",
+                                                 kind="param_replay")
+                out = self._maybe_corrupt(
+                    x.with_values(self.initial_amps + 0, ()))
+                t_c = time.perf_counter()
+                jax.block_until_ready(out)
+                t_d = time.perf_counter()
+                retraced = telemetry.counter_value(
+                    "engine_trace_total", kind="param_replay") > before
+                for req in traced:
+                    tr = req.trace
+                    tr.phase("cache_lookup", t_a, t_b - t_a)
+                    tr.phase("compile" if retraced else "dispatch",
+                             t_b, t_c - t_b)
+                    tr.phase("device", t_c, t_d - t_c)
+            else:
+                out = self._maybe_corrupt(
+                    x.with_values(self.initial_amps + 0, ()))
             self._sentinel_gate(out)
+            rt = time.perf_counter() if traced else 0.0
             for req in batch:
+                if req.trace is not None:
+                    self._trace_done(req, rt, time.perf_counter())
                 _sync.resolve_future(req.fut, result=out,
                                      site="engine.dispatch")
             return
+        # host-side batch assembly (pad to the fixed vmap shape): on the
+        # traced path this lands in the dispatch phase
+        t_asm = time.perf_counter() if traced else 0.0
         pad = self.max_batch - len(batch)
         vals = [req.values for req in batch] + [batch[-1].values] * pad
         stacked = tuple(jnp.stack([v[k] for v in vals])
                         for k in range(len(self._lifted.slots)))
         amps_b = jnp.repeat(self.initial_amps[None], self.max_batch, axis=0)
+        t_a = time.perf_counter() if traced else 0.0
+        fnB = self._execB()
+        if traced:
+            import jax
+
+            t_b = time.perf_counter()
+            before = telemetry.counter_value("engine_trace_total",
+                                             kind="param_replay")
         # the whole coalesced batch is ONE vmap program launch
         telemetry.inc("device_dispatch_total", route="engine_vmap")
-        out = self._execB()(amps_b, stacked)
+        out = fnB(amps_b, stacked)
+        if traced:
+            t_c = time.perf_counter()
+            jax.block_until_ready(out)
+            t_d = time.perf_counter()
+            retraced = telemetry.counter_value(
+                "engine_trace_total", kind="param_replay") > before
+            for req in traced:
+                tr = req.trace
+                tr.phase("cache_lookup", t_a, t_b - t_a)
+                tr.phase("dispatch", t_asm, t_a - t_asm)
+                tr.phase("compile" if retraced else "dispatch",
+                         t_b, t_c - t_b)
+                tr.phase("device", t_c, t_d - t_c)
+        # each request's resolve phase runs from the device sync to ITS
+        # resolution: lane extraction (a compiled slice on the first
+        # run), the sentinel gate, and the wait behind earlier lanes.
+        # The windows deliberately overlap -- phases tile each request's
+        # own end-to-end latency, they are not a global partition.
         for i, req in enumerate(batch):
             lane = self._maybe_corrupt(out[i])
             self._sentinel_gate(lane)
+            if req.trace is not None:
+                self._trace_done(req, t_d, time.perf_counter())
             _sync.resolve_future(req.fut, result=lane,
                                  site="engine.dispatch")

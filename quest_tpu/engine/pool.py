@@ -140,7 +140,8 @@ class _PoolRequest:
 
     __slots__ = ("circuit", "fingerprint", "params", "tenant", "priority",
                  "fut", "deadline", "t0", "attempts", "failed", "inner",
-                 "hedged", "dispatched_at", "last_exc", "settled")
+                 "hedged", "dispatched_at", "last_exc", "settled",
+                 "trace", "last_span", "mark")
 
     def __init__(self, circuit, fingerprint, params, tenant, priority,
                  deadline):
@@ -154,11 +155,14 @@ class _PoolRequest:
         self.t0 = time.monotonic()
         self.attempts = 0
         self.failed: set = set()          # replica ids this request failed on
-        self.inner: list = []             # (replica, engine_future, is_hedge)
+        self.inner: list = []    # (replica, engine_future, is_hedge, span)
         self.hedged = False
         self.dispatched_at: float | None = None
         self.last_exc = None
         self.settled = False
+        self.trace = None                 # pool-minted TraceContext root
+        self.last_span = None             # most recent attempt/hedge span
+        self.mark = 0.0  # perf_counter of the last phase-attributed point
 
     def remaining(self) -> float | None:
         if self.deadline is None:
@@ -277,7 +281,29 @@ class EnginePool:
         with self._cv:
             if self._closed:
                 raise RuntimeError("EnginePool is closed")
-        self.admission.admit(tenant, priority, len(params_list))
+        # tracing (round 17): one boolean read when off; the pool mints
+        # the request's root trace (backdated to admission entry) and its
+        # settle owns finishing it -- the engines the attempts land on
+        # adopt the attempt span and only close their own children
+        tracing = telemetry.trace_on()
+        t_adm = time.perf_counter() if tracing else 0.0
+        try:
+            self.admission.admit(tenant, priority, len(params_list))
+        except QuESTBackpressureError as e:
+            if tracing:
+                # errored requests are ALWAYS captured, and an admission
+                # shed errors before any request object exists: mint a
+                # one-span error trace for the batch
+                ctx = telemetry.start_trace(
+                    "request", t0=t_adm, kind="pool", tenant=tenant,
+                    priority=priority)
+                if ctx is not None:
+                    ctx.record_span("pool.admission", t_adm,
+                                    time.perf_counter() - t_adm,
+                                    status="error")
+                    telemetry.finish_trace(ctx, error=type(e).__name__)
+            raise
+        t_admitted = time.perf_counter() if tracing else 0.0
         telemetry.inc("pool_requests_total", len(params_list),
                       tenant=tenant, priority=priority)
         fp = circuit.fingerprint()
@@ -288,6 +314,14 @@ class EnginePool:
         for params in params_list:
             req = _PoolRequest(circuit, fp, params, tenant, priority,
                                deadline)
+            if tracing:
+                req.trace = telemetry.start_trace(
+                    "request", t0=t_adm, kind="pool", tenant=tenant,
+                    priority=priority)
+                req.mark = t_adm
+                if req.trace is not None:
+                    req.trace.record_span("pool.admission", t_adm,
+                                          t_admitted - t_adm)
             futs.append(req.fut)
             self._route(req)
         return futs
@@ -349,9 +383,33 @@ class EnginePool:
                 "EnginePool.submit"))
             return
         if parked:
+            if req.trace is not None:
+                req.trace.event("parked", priority=req.priority)
             self.admission.note_queued(req.tenant, req.priority)
             return
         self._dispatch_attempt(req, rep)
+
+    def _attempt_span(self, req: _PoolRequest, rep: _Replica, name: str,
+                      link_kind: str):
+        """Open one attempt span (time since the last attributed point
+        lands in ``queue_wait``) and link it to the previous attempt --
+        the failover/hedge causality edge the waterfall renders."""
+        now = time.perf_counter()
+        if now > req.mark:
+            req.trace.phase("queue_wait", req.mark, now - req.mark)
+        req.mark = now
+        sp = req.trace.child(name, replica=rep.id, attempt=req.attempts)
+        if req.last_span is not None:
+            sp.link(req.last_span, kind=link_kind)
+        req.last_span = sp
+        return sp
+
+    def _attempt_failed(self, req: _PoolRequest, sp) -> None:
+        """Close a failed attempt span; the re-route that follows charges
+        its latency to ``queue_wait`` from here."""
+        if sp is not None:
+            sp.end(status="error")
+            req.mark = time.perf_counter()
 
     def _dispatch_attempt(self, req: _PoolRequest, rep: _Replica) -> None:
         req.attempts += 1
@@ -360,6 +418,8 @@ class EnginePool:
                 f"request failed over {req.attempts - 1} time(s) without "
                 f"a replica completing it", "EnginePool.submit"))
             return
+        sp = None if req.trace is None else \
+            self._attempt_span(req, rep, "pool.attempt", "failover")
         if _faults.enabled():
             # the injectable replica-death point: one visit per routed
             # dispatch attempt, so a plan's nth visit replays identically
@@ -369,6 +429,7 @@ class EnginePool:
                 req.last_exc = QuESTCancelledError(
                     f"injected {kind} fault at site 'pool.replica' "
                     f"(replica {rep.id})", "EnginePool._dispatch")
+                self._attempt_failed(req, sp)
                 self._quarantine(rep, reason=kind)
                 telemetry.inc("pool_failovers_total", reason=kind)
                 self._route(req)
@@ -376,10 +437,25 @@ class EnginePool:
         eng = None
         try:
             eng = self._engine_for(rep, req.fingerprint, req.circuit)
-            f = eng.submit(req.params, timeout=req.remaining())
+            if req.trace is not None:
+                # engine resolution (a miss builds + compiles) is the
+                # pool-side cache_lookup phase
+                now = time.perf_counter()
+                req.trace.phase("cache_lookup", req.mark, now - req.mark)
+                req.mark = now
+            f = self._adopted_submit(req, sp, eng)
+            if req.trace is not None:
+                # the submit hop (param bind + engine-lock wait, which
+                # can block behind the batcher) is queueing too; the few
+                # microseconds of overlap with the engine-side
+                # queue_wait window are inside the 10% tiling tolerance
+                now = time.perf_counter()
+                req.trace.phase("queue_wait", req.mark, now - req.mark)
+                req.mark = now
         except QuESTBackpressureError as e:
             req.failed.add(rep.id)
             req.last_exc = e
+            self._attempt_failed(req, sp)
             if eng is not None and eng.health() == "quarantined":
                 self._quarantine(rep, reason="quarantined")
             telemetry.inc("pool_failovers_total", reason="backpressure")
@@ -395,21 +471,48 @@ class EnginePool:
                 req.last_exc = QuESTCancelledError(
                     f"replica {rep.id} closed during dispatch",
                     "EnginePool._dispatch")
+                self._attempt_failed(req, sp)
                 telemetry.inc("pool_failovers_total", reason="closed")
                 self._route(req)
                 return
+            self._attempt_failed(req, sp)
             self._settle(req, exc=e)
             return
         except BaseException as e:
+            self._attempt_failed(req, sp)
             self._settle(req, exc=e)
             return
         with self._cv:
             req.dispatched_at = time.monotonic()
-            req.inner.append((rep, f, False))
+            req.inner.append((rep, f, False, sp))
             rep.outstanding.add(req)
         f.add_done_callback(
             lambda fut, req=req, rep=rep: self._on_done(req, rep, fut,
                                                         hedge=False))
+
+    def _adopted_submit(self, req: _PoolRequest, sp, eng):
+        """``Engine.submit`` with this request's attempt span bound to the
+        submitting thread, so the engine adopts it as the parent of its
+        ``engine.request`` child (ONE waterfall across the hop). The
+        previous binding is restored: a failover re-dispatch runs on an
+        engine batcher thread that is still working for its own batch."""
+        if req.trace is None:
+            if not telemetry.trace_on():
+                return eng.submit(req.params, timeout=req.remaining())
+            # rate-sampled out: shield the engine from adopting whatever
+            # trace the dispatching thread happens to be bound to
+            prev = telemetry.current_traces()
+            telemetry.set_current_trace(None)
+            try:
+                return eng.submit(req.params, timeout=req.remaining())
+            finally:
+                telemetry.set_current_trace(prev or None)
+        prev = telemetry.current_traces()
+        telemetry.set_current_trace(sp)
+        try:
+            return eng.submit(req.params, timeout=req.remaining())
+        finally:
+            telemetry.set_current_trace(prev or None)
 
     def _settle(self, req: _PoolRequest, result=None, exc=None) -> bool:
         """Resolve the caller's future exactly once (concurrent engine
@@ -419,6 +522,21 @@ class EnginePool:
                 return False
             req.settled = True
             self._cv.notify_all()
+        if req.trace is not None:
+            # the pool minted this root, so the pool finishes it -- BEFORE
+            # resolving, so a woken caller observes a complete trace. The
+            # window since the last attributed point (the engine handoff
+            # in _on_done) is the pool-side resolve; a request that never
+            # reached an engine only ever waited
+            now = time.perf_counter()
+            if now > req.mark:
+                req.trace.phase(
+                    "resolve" if req.dispatched_at is not None
+                    else "queue_wait", req.mark, now - req.mark)
+                req.mark = now
+            telemetry.finish_trace(
+                req.trace,
+                error=None if exc is None else type(exc).__name__)
         # resolution happens OUTSIDE the pool lock (the settled flag above
         # is the once-guard); resolve_future re-verifies that under
         # QUEST_CONCHECK=1 (QT602 on any instrumented lock still held)
@@ -430,7 +548,11 @@ class EnginePool:
 
     def _on_done(self, req: _PoolRequest, rep: _Replica, fut,
                  *, hedge: bool) -> None:
+        if req.trace is not None:
+            # engine -> pool handoff: phase attribution resumes here
+            req.mark = time.perf_counter()
         with self._cv:
+            mine = next((p[3] for p in req.inner if p[1] is fut), None)
             req.inner = [p for p in req.inner if p[1] is not fut]
             if not any(p[0] is rep for p in req.inner):
                 rep.outstanding.discard(req)
@@ -438,20 +560,33 @@ class EnginePool:
             settled = req.settled
             self._cv.notify_all()
         if fut.cancelled():
+            if mine is not None:
+                mine.end(status="cancelled")
             return  # a hedge loser we cancelled while still queued
         exc = fut.exception()
         if settled:
-            return  # hedge loser (or late failover echo): drop silently
+            # hedge loser (or late failover echo): drop silently, but the
+            # waterfall marks the losing span cancelled
+            if mine is not None:
+                mine.end(status="cancelled")
+            return
         if exc is None:
+            if mine is not None:
+                mine.end()
             if self._settle(req, result=fut.result()):
                 if req.hedged:
                     telemetry.inc("pool_hedges_total",
                                   outcome=("won_hedge" if hedge
                                            else "won_primary"))
-                for _rep2, f2, _h in siblings:
+                for _rep2, f2, _h, sp2 in siblings:
                     f2.cancel()  # engines guard fut.done(): safe either way
+                    if sp2 is not None:
+                        sp2.end(status="cancelled")
             self._drain_pending()
             return
+        if mine is not None:
+            mine.end(status="error")
+            req.last_span = mine  # the failover link target
         # a replica-level failure quarantines the replica...
         if isinstance(exc, QuESTHangError):
             self._quarantine(rep, reason="hang")
@@ -661,6 +796,16 @@ class EnginePool:
         telemetry.inc("pool_hedges_total", outcome="issued")
         telemetry.event("pool.hedge", replica=peer.id,
                         attempts=req.attempts)
+        sp = None
+        if req.trace is not None:
+            # the hedged duplicate links to the outstanding primary
+            # attempt; note the duplicate does NOT take over last_span or
+            # the phase mark -- the primary still owns the request unless
+            # the hedge wins, and _on_done marks the loser cancelled
+            sp = req.trace.child("pool.hedge", replica=peer.id,
+                                 attempt=req.attempts)
+            if req.last_span is not None:
+                sp.link(req.last_span, kind="hedge")
 
         def attempt():
             return self._engine_for(peer, req.fingerprint,
@@ -668,14 +813,27 @@ class EnginePool:
                 req.params, timeout=req.remaining())
 
         try:
-            f = _retry.call_with_retry(attempt, site="pool.hedge",
-                                       retryable=(QuESTBackpressureError,))
+            if sp is not None or telemetry.trace_on():
+                prev = telemetry.current_traces()
+                telemetry.set_current_trace(sp)
+                try:
+                    f = _retry.call_with_retry(
+                        attempt, site="pool.hedge",
+                        retryable=(QuESTBackpressureError,))
+                finally:
+                    telemetry.set_current_trace(prev or None)
+            else:
+                f = _retry.call_with_retry(
+                    attempt, site="pool.hedge",
+                    retryable=(QuESTBackpressureError,))
         except Exception:
+            if sp is not None:
+                sp.end(status="error")
             with self._cv:
                 req.hedged = False  # primary still owns it; may re-hedge
             return
         with self._cv:
-            req.inner.append((peer, f, True))
+            req.inner.append((peer, f, True, sp))
             peer.outstanding.add(req)
         f.add_done_callback(
             lambda fut, req=req, rep=peer: self._on_done(req, rep, fut,

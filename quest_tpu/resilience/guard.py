@@ -74,6 +74,10 @@ def pallas_dispatch(attempt: Callable[[], T],
         telemetry.inc("engine_fallback_total", reason="fault_degraded")
         telemetry.event("resilience.degrade", site=site,
                         kind=getattr(e, "kind", type(e).__name__))
+        if telemetry.trace_on():
+            telemetry.trace_event_current(
+                "degrade", site=site,
+                kind=getattr(e, "kind", type(e).__name__))
         degrade()
         return DEGRADED
 
@@ -237,6 +241,10 @@ def sentinel_replay(replay: Callable[[], T],
         telemetry.inc("engine_fallback_total", reason="sentinel_degraded")
         telemetry.event("resilience.sentinel_degrade", site=site,
                         findings=len(getattr(e, "findings", ())))
+        if telemetry.trace_on():
+            telemetry.trace_event_current(
+                "degrade", site=site, kind="sentinel",
+                findings=len(getattr(e, "findings", ())))
         try:
             out = degrade()
         except QuESTIntegrityError:

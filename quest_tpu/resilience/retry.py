@@ -120,10 +120,21 @@ def call_with_retry(fn: Callable[[], T], *, site: str,
                                 attempts=attempt,
                                 deadline=bool(over_deadline),
                                 error=type(e).__name__)
+                if telemetry.trace_on():
+                    telemetry.trace_event_current(
+                        "retry.exhausted", site=site, attempt=attempt,
+                        error=type(e).__name__)
                 raise
             failed = True
             telemetry.inc("retry_attempts_total", site=site,
                           outcome="retried")
+            if telemetry.trace_on():
+                # each failed attempt shows as an instant on every trace
+                # the calling thread is working for (the retry-attempts
+                # causal links the waterfall renders)
+                telemetry.trace_event_current(
+                    "retry.attempt", site=site, attempt=attempt,
+                    error=type(e).__name__)
             delay = next(delays, pol.base_delay_s)
             if pol.deadline_s is not None:
                 delay = min(delay, max(

@@ -383,7 +383,15 @@ class Lock:
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         ctrl = _controller
         if ctrl is not None and ctrl.controls_current():
-            return ctrl.op_acquire(self, blocking, timeout)
+            if self.record:
+                return ctrl.op_acquire(self, blocking, timeout)
+            # record=False locks (the telemetry registry) guard leaf
+            # bookkeeping: no code parks while holding one, and no
+            # scenario invariant depends on their interleaving. Skipping
+            # the scheduling point keeps schedule depth proportional to
+            # the locks that matter, not to metric traffic -- the checked
+            # acquire still feeds the held stack and the order graph.
+            return _acquire_checked(self, blocking, timeout)
         if not _env_read:
             _load_env()
         if not _active:
@@ -393,7 +401,10 @@ class Lock:
     def release(self) -> None:
         ctrl = _controller
         if ctrl is not None and ctrl.controls_current():
-            ctrl.op_release(self)
+            if self.record:
+                ctrl.op_release(self)
+                return
+            _release_checked(self)
             return
         if not _active:
             self._real.release()

@@ -275,11 +275,29 @@ def run_slice(circuit, qureg, lo: int = 0, hi: int | None = None, *,
     hi = len(circuit._tape) if hi is None else hi
     if hi <= lo:
         return qureg
+    ctx = telemetry.current_trace() if telemetry.trace_on() else None
     with fusion.pallas_mesh(_register_mesh(qureg)):
         if segment_dispatch_enabled():
             fn = slice_executable(circuit, lo, hi, donate=donate)
             telemetry.inc("device_dispatch_total", route="segment")
-            qureg.put(fn(qureg.amps))
+            if ctx is not None:
+                # the segment launch splits into its dispatch/device
+                # phases: an explicit sync separates the host-side
+                # launch from the device drain (armed path only -- the
+                # untraced path never blocks)
+                import time as _time
+
+                import jax as _jax
+                t0 = _time.perf_counter()
+                out = fn(qureg.amps)
+                t1 = _time.perf_counter()
+                _jax.block_until_ready(out)
+                t2 = _time.perf_counter()
+                ctx.phase("dispatch", t0, t1 - t0)
+                ctx.phase("device", t1, t2 - t1)
+                qureg.put(out)
+            else:
+                qureg.put(fn(qureg.amps))
         else:
             for f, a, kw in circuit._tape[lo:hi]:
                 telemetry.inc("device_dispatch_total", route="item")

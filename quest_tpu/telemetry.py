@@ -22,6 +22,20 @@ of them report into and every artifact is derived from:
 - **Snapshots**: :func:`snapshot` returns the whole registry as one nested
   JSON-ready dict -- ``bench.py`` embeds it in ``BENCH_DETAIL.json`` so the
   per-pass / comm-volume / fallback story ships with every headline number.
+- **Request traces** (round 17): a :class:`TraceContext`
+  (trace_id / span_id / parent_id) minted at ``Engine.submit`` /
+  ``EnginePool.submit`` and propagated across every thread hop of the
+  serving path, with causal span links for hedges, failovers, retries and
+  bisection halves. Each request accumulates the canonical :data:`PHASES`
+  vector (``queue_wait``/``coalesce``/``cache_lookup``/``compile``/
+  ``dispatch``/``device``/``resolve``) into ``request_phase_ms{phase}``
+  histograms (p50/p95/p99 in :func:`snapshot`), and completed traces
+  export as Perfetto-loadable Chrome trace JSON
+  (:func:`export_chrome_trace`, ``tools/traceview.py``). Sampling is
+  head-based via ``QUEST_TRACE=off|errors|<rate>|all`` (malformed values
+  warn once as QT701); errored requests are always captured; the off
+  path is one boolean read (:func:`trace_on`), same contract as
+  :func:`span`.
 
 Semantics notes:
 
@@ -51,6 +65,11 @@ __all__ = [
     "enabled", "disabled", "inc", "set_gauge", "observe", "span", "event",
     "counter_value", "counter_total", "counters", "snapshot", "reset",
     "export_jsonl", "events",
+    "PHASES", "TraceContext", "trace_on", "trace_mode", "trace_policy",
+    "start_trace", "finish_trace", "current_trace", "current_traces",
+    "set_current_trace", "clear_current_trace", "trace_event_current",
+    "traces", "trace_thread_leaks", "export_chrome_trace", "export_traces",
+    "chrome_trace_events",
 ]
 
 #: import-time master switch; QUEST_TELEMETRY=0 swaps in the no-op stubs
@@ -60,9 +79,29 @@ _ENV_ENABLED = os.environ.get("QUEST_TELEMETRY", "1").strip().lower() \
 #: if set, every completed span / event streams one JSON line here
 _JSONL_ENV = "QUEST_TELEMETRY_JSONL"
 
-#: cap on the in-memory event ring (oldest dropped first): a flight
-#: recorder must never grow without bound inside a long-lived server
+#: default cap on the in-memory event ring (oldest dropped first,
+#: counted in ``telemetry_events_dropped_total``): a flight recorder must
+#: never grow without bound inside a long-lived server. Overridable via
+#: QUEST_TELEMETRY_EVENTS_MAX (parsed lazily at first event; QT303
+#: warn-once on malformed values).
 _MAX_EVENTS = 1 << 16
+_EVENTS_MAX_ENV = "QUEST_TELEMETRY_EVENTS_MAX"
+_EVENTS_MAX_WARNED: set = set()
+
+#: the canonical per-request phase vector (docs/observability.md): every
+#: finished trace carries all seven keys (0.0 when a phase never ran)
+PHASES = ("queue_wait", "coalesce", "cache_lookup", "compile",
+          "dispatch", "device", "resolve")
+
+#: head-based trace sampling knob: off | errors | <rate in (0,1)> | all
+_TRACE_ENV = "QUEST_TRACE"
+_TRACE_WARNED: set = set()
+
+#: cap on retained finished traces (oldest dropped first)
+_MAX_TRACES = 4096
+
+#: per-series reservoir cap backing the p50/p95/p99 snapshot rollups
+_SAMPLE_CAP = 8192
 
 
 def _label_key(labels: dict) -> str:
@@ -149,6 +188,10 @@ class MetricsRegistry:
         self.enabled = _ENV_ENABLED
         self._jsonl_fh = None
         self._jsonl_path = os.environ.get(_JSONL_ENV)
+        #: event-ring cap; resolved lazily at the first append so the
+        #: QT303 diagnostic (which imports analysis.diagnostics, which
+        #: imports this module) never runs during telemetry bootstrap
+        self._events_max: int | None = None
         self._reset_locked()
 
     # -- storage ------------------------------------------------------------
@@ -159,6 +202,16 @@ class MetricsRegistry:
         self._hists: dict[str, dict] = {}
         self._spans: dict[str, dict] = {}
         self._events: list[dict] = []
+        self._events_dropped = 0
+        #: bounded raw-sample reservoirs backing snapshot percentiles,
+        #: series-keyed like _hists (only observe_sampled series get one)
+        self._samples: dict[str, list] = {}
+        #: retained finished request traces (JSON-ready dicts)
+        self._traces: list[dict] = []
+        #: thread ident -> (thread name, live TraceContext tuple): the
+        #: QT703 leak scan reads this (a pooled thread that still holds a
+        #: finished trace after future resolution leaked its context)
+        self._thread_traces: dict[int, tuple] = {}
 
     def reset(self) -> None:
         """Drop every recorded metric and event (tests, bench sections)."""
@@ -207,6 +260,34 @@ class MetricsRegistry:
                 h["min"] = min(h["min"], v)
                 h["max"] = max(h["max"], v)
 
+    def observe_sampled(self, name: str, value: float, **labels) -> None:
+        """:meth:`observe`, plus the raw value lands in a bounded
+        per-series reservoir (sliding window of the last ``_SAMPLE_CAP``)
+        so :meth:`snapshot` can report p50/p95/p99 for this series. Used
+        for the SLO rollup series (``request_phase_ms{phase}``); ordinary
+        histograms stay count/sum/min/max."""
+        if not self.enabled:
+            return
+        key = _series_key(name, labels)
+        v = float(value)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = {"count": 1, "sum": v,
+                                        "min": v, "max": v}
+            else:
+                h["count"] += 1
+                h["sum"] += v
+                h["min"] = min(h["min"], v)
+                h["max"] = max(h["max"], v)
+            s = self._samples.get(key)
+            if s is None:
+                s = self._samples[key] = []
+            if len(s) < _SAMPLE_CAP:
+                s.append(v)
+            else:
+                s[(h["count"] - 1) % _SAMPLE_CAP] = v
+
     def span(self, name: str, **labels):
         """Context manager timing a nested host-side region."""
         if not self.enabled:
@@ -235,11 +316,35 @@ class MetricsRegistry:
                             "path": sp.path, "dur_s": round(sp.duration_s, 9),
                             **({"labels": sp.labels} if sp.labels else {})})
 
+    def _events_cap(self) -> int:
+        """The ring cap, parsed from QUEST_TELEMETRY_EVENTS_MAX on first
+        use (outside the registry lock: the QT303 warn-once path records
+        a finding counter, which takes it)."""
+        cap = self._events_max
+        if cap is None:
+            cap = _MAX_EVENTS
+            if os.environ.get(_EVENTS_MAX_ENV, "").strip():
+                try:
+                    from .analysis.diagnostics import parse_env_int
+                    cap = parse_env_int(
+                        _EVENTS_MAX_ENV, _MAX_EVENTS, minimum=1,
+                        code="QT303", warned=_EVENTS_MAX_WARNED,
+                        noun="telemetry event-buffer cap")
+                except ImportError:  # pragma: no cover - bootstrap only
+                    pass
+            self._events_max = cap
+        return cap
+
     def _append_event(self, ev: dict) -> None:
+        cap = self._events_cap()
         with self._lock:
             self._events.append(ev)
-            if len(self._events) > _MAX_EVENTS:
-                del self._events[: len(self._events) - _MAX_EVENTS]
+            drop = len(self._events) - cap
+            if drop > 0:
+                del self._events[:drop]
+                self._events_dropped += drop
+                key = "telemetry_events_dropped_total"
+                self._counters[key] = self._counters.get(key, 0.0) + drop
         path = self._jsonl_path
         if path:
             self._stream_jsonl(ev, path)
@@ -289,6 +394,17 @@ class MetricsRegistry:
         def num(v):
             return int(v) if float(v).is_integer() else round(v, 6)
 
+        def hist(k, h):
+            out = {"count": h["count"], "sum": round(h["sum"], 6),
+                   "min": round(h["min"], 6), "max": round(h["max"], 6)}
+            s = self._samples.get(k)
+            if s:  # percentile rollups only for reservoir-backed series
+                arr = sorted(s)
+                for q, lbl in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                    out[lbl] = round(
+                        arr[min(len(arr) - 1, int(q * len(arr)))], 6)
+            return out
+
         with self._lock:
             return {
                 "counters": {k: num(v)
@@ -298,8 +414,7 @@ class MetricsRegistry:
                            for k, v in sorted(self._gauges.items())
                            if keep(k)},
                 "histograms": {
-                    k: {"count": h["count"], "sum": round(h["sum"], 6),
-                        "min": round(h["min"], 6), "max": round(h["max"], 6)}
+                    k: hist(k, h)
                     for k, h in sorted(self._hists.items()) if keep(k)},
                 "spans": {
                     k: {"count": a["count"],
@@ -315,11 +430,18 @@ class MetricsRegistry:
 
     def export_jsonl(self, path: str, clear: bool = False) -> int:
         """Write every buffered event as one JSON line each; returns the
-        number written. ``clear`` drops the buffer afterwards."""
+        number of lines written. ``clear`` drops the buffer afterwards.
+        When the ring dropped events (buffer cap, satellite of round 17)
+        a leading ``{"kind": "meta", ...}`` line reports how many, so a
+        consumer can tell a quiet server from a saturated ring."""
         with self._lock:
             evs = list(self._events)
+            dropped = self._events_dropped
             if clear:
                 self._events = []
+        if dropped:
+            evs.insert(0, {"kind": "meta", "events_dropped": dropped,
+                           "events_max": self._events_cap()})
         with open(path, "w") as fh:
             for ev in evs:
                 fh.write(json.dumps(ev) + "\n")
@@ -402,6 +524,452 @@ def events() -> list:
 
 
 # ---------------------------------------------------------------------------
+# request tracing (round 17): causal span trees across the serving fleet
+# ---------------------------------------------------------------------------
+
+#: resolved QUEST_TRACE policy: mode in {"off","errors","rate","all"},
+#: rate in [0,1]. Resolved lazily on the first trace_on() call so the
+#: QT701 diagnostic (analysis.diagnostics imports this module) never runs
+#: during telemetry bootstrap; trace_policy() overrides it in-process.
+_TRACE_MODE = "off"
+_TRACE_RATE = 0.0
+_TRACE_RESOLVED = False
+
+#: per-process monotonic trace-id sequence (advanced under REGISTRY._lock)
+_TRACE_SEQ = 0
+
+
+def _parse_trace(raw: str):
+    """(mode, rate, error) for one QUEST_TRACE value; error is a human
+    fragment when the value is malformed (mode falls back to off)."""
+    v = raw.strip().lower()
+    if v in ("", "off", "0", "0.0", "false", "none"):
+        return "off", 0.0, None
+    if v in ("errors", "error"):
+        return "errors", 0.0, None
+    if v in ("all", "on", "1", "1.0", "true"):
+        return "all", 1.0, None
+    try:
+        rate = float(v)
+    except ValueError:
+        return "off", 0.0, "is not off|errors|<rate in (0,1)>|all"
+    if not 0.0 <= rate <= 1.0:
+        return "off", 0.0, f"rate {rate:g} is outside [0, 1]"
+    if rate >= 1.0:
+        return "all", 1.0, None
+    return "rate", rate, None
+
+
+def _resolve_trace_mode() -> None:
+    global _TRACE_MODE, _TRACE_RATE, _TRACE_RESOLVED
+    raw = os.environ.get(_TRACE_ENV, "")
+    mode, rate, err = _parse_trace(raw)
+    if err is not None and raw.strip() not in _TRACE_WARNED:
+        _TRACE_WARNED.add(raw.strip())
+        try:  # deferred: diagnostics imports telemetry, never the reverse
+            import warnings
+
+            from .analysis.diagnostics import emit_findings, make_finding
+            f = make_finding(
+                "QT701",
+                f"{_TRACE_ENV}={raw!r} {err}; tracing stays off",
+                f"env:{_TRACE_ENV}")
+            emit_findings([f])
+            warnings.warn(str(f), RuntimeWarning, stacklevel=4)
+        except ImportError:  # pragma: no cover - bootstrap only
+            pass
+    _TRACE_MODE, _TRACE_RATE, _TRACE_RESOLVED = mode, rate, True
+
+
+def trace_on() -> bool:
+    """True when request tracing is armed. The hot-path contract matches
+    :func:`span`: with QUEST_TRACE unset this is one boolean read (after
+    a one-time env parse) and every instrumented site bails on it."""
+    if not _TRACE_RESOLVED:
+        _resolve_trace_mode()
+    return _TRACE_MODE != "off" and REGISTRY.enabled
+
+
+def trace_mode() -> str:
+    """The resolved sampling mode: off | errors | rate | all."""
+    if not _TRACE_RESOLVED:
+        _resolve_trace_mode()
+    return _TRACE_MODE
+
+
+@contextlib.contextmanager
+def trace_policy(mode):
+    """In-process QUEST_TRACE override (bench phase sections, tests):
+    ``with trace_policy("all"): ...`` arms tracing regardless of the
+    environment, restoring the prior policy on exit. Raises ValueError
+    on a malformed mode (in-process callers get errors, not QT701)."""
+    global _TRACE_MODE, _TRACE_RATE, _TRACE_RESOLVED
+    m, r, err = _parse_trace(str(mode))
+    if err is not None:
+        raise ValueError(f"bad trace mode {mode!r}: {err}")
+    prev = (_TRACE_MODE, _TRACE_RATE, _TRACE_RESOLVED)
+    _TRACE_MODE, _TRACE_RATE, _TRACE_RESOLVED = m, r, True
+    try:
+        yield
+    finally:
+        _TRACE_MODE, _TRACE_RATE, _TRACE_RESOLVED = prev
+
+
+class _Trace:
+    """Shared mutable state of one request trace; every
+    :class:`TraceContext` handle points at one of these. Mutated only
+    under ``REGISTRY._lock``."""
+
+    __slots__ = ("trace_id", "name", "labels", "wall0", "perf0", "spans",
+                 "links", "events", "phases", "error", "sampled", "done",
+                 "nspans")
+
+    def __init__(self, trace_id, name, labels, wall0, perf0, sampled):
+        self.trace_id = trace_id
+        self.name = name
+        self.labels = labels
+        self.wall0 = wall0      # epoch seconds at perf0 (chrome ts base)
+        self.perf0 = perf0      # perf_counter origin for span offsets
+        self.spans: dict[str, dict] = {}
+        self.links: list[dict] = []
+        self.events: list[dict] = []
+        self.phases: dict[str, float] = {}
+        self.error = None
+        self.sampled = sampled
+        self.done = False
+        self.nspans = 0
+
+
+class TraceContext:
+    """A handle onto one span of one request trace.
+
+    Minted by :func:`start_trace` (the root span, ``owns_root=True``) and
+    by :meth:`child`; carries ``trace_id`` / ``span_id`` / ``parent_id``
+    across thread hops. The layer that minted the root finishes it
+    (:func:`finish_trace`); adopted child contexts only :meth:`end` their
+    own span. All methods are cheap dict appends under the registry lock
+    and are only ever called on the armed path (``trace_on()`` gated)."""
+
+    __slots__ = ("_tr", "span_id", "owns_root")
+
+    def __init__(self, tr: _Trace, span_id: str, owns_root: bool):
+        self._tr = tr
+        self.span_id = span_id
+        self.owns_root = owns_root
+
+    @property
+    def trace_id(self) -> str:
+        return self._tr.trace_id
+
+    @property
+    def parent_id(self):
+        sp = self._tr.spans.get(self.span_id)
+        return sp["parent"] if sp else None
+
+    @property
+    def done(self) -> bool:
+        return self._tr.done
+
+    def _add_span(self, name, parent, t0, dur_ms, status, labels,
+                  cat=None) -> str:
+        tr = self._tr
+        with REGISTRY._lock:
+            sid = f"s{tr.nspans}"
+            tr.nspans += 1
+            sp = {"id": sid, "parent": parent, "name": name,
+                  "t0_ms": round((t0 - tr.perf0) * 1e3, 6),
+                  "dur_ms": dur_ms, "status": status,
+                  "thread": threading.current_thread().name}
+            if cat:
+                sp["cat"] = cat
+            if labels:
+                sp["labels"] = labels
+            tr.spans[sid] = sp
+        return sid
+
+    def child(self, name: str, **labels) -> "TraceContext":
+        """Open a child span under this one; the returned context must be
+        :meth:`end`-ed (a finished trace with an open span is QT702)."""
+        sid = self._add_span(name, self.span_id, time.perf_counter(),
+                             None, "open", labels)
+        return TraceContext(self._tr, sid, False)
+
+    def end(self, status: str = "ok") -> None:
+        """Close this context's span (idempotent)."""
+        now = time.perf_counter()
+        tr = self._tr
+        with REGISTRY._lock:
+            sp = tr.spans.get(self.span_id)
+            if sp is not None and sp["dur_ms"] is None:
+                sp["dur_ms"] = round(
+                    (now - tr.perf0) * 1e3 - sp["t0_ms"], 6)
+                sp["status"] = status
+
+    def record_span(self, name: str, t0: float, dur_s: float,
+                    status: str = "ok", **labels) -> str:
+        """Record an already-measured closed span (``t0`` from
+        ``time.perf_counter()``) under this context; returns its id."""
+        return self._add_span(name, self.span_id, t0,
+                              round(dur_s * 1e3, 6), status, labels)
+
+    def phase(self, name: str, t0: float, dur_s: float) -> None:
+        """Attribute ``dur_s`` to the canonical phase ``name``: the trace's
+        phase vector accumulates it AND a closed ``cat="phase"`` span is
+        recorded so the waterfall shows where the time sat."""
+        tr = self._tr
+        ms = dur_s * 1e3
+        with REGISTRY._lock:
+            tr.phases[name] = tr.phases.get(name, 0.0) + ms
+            sid = f"s{tr.nspans}"
+            tr.nspans += 1
+            tr.spans[sid] = {
+                "id": sid, "parent": self.span_id, "name": name,
+                "t0_ms": round((t0 - tr.perf0) * 1e3, 6),
+                "dur_ms": round(ms, 6), "status": "ok", "cat": "phase",
+                "thread": threading.current_thread().name}
+
+    def add_link(self, frm, to, kind: str) -> None:
+        """Record a causal link between two spans (hedge duplicate ->
+        primary, failover re-dispatch -> failed attempt, retry attempts,
+        bisection halves). ``frm``/``to`` are contexts or span ids."""
+        fid = frm.span_id if isinstance(frm, TraceContext) else frm
+        tid = to.span_id if isinstance(to, TraceContext) else to
+        with REGISTRY._lock:
+            self._tr.links.append({"from": fid, "to": tid, "kind": kind})
+
+    def link(self, to, kind: str) -> None:
+        """:meth:`add_link` from this context's span."""
+        self.add_link(self, to, kind)
+
+    def event(self, name: str, **fields) -> None:
+        """Append a point event to the trace (rendered as instants)."""
+        tr = self._tr
+        t_ms = round((time.perf_counter() - tr.perf0) * 1e3, 6)
+        with REGISTRY._lock:
+            tr.events.append({"name": name, "t_ms": t_ms, "span": self.span_id,
+                              **({"fields": fields} if fields else {})})
+
+
+def start_trace(name: str, t0: float | None = None,
+                **labels) -> TraceContext | None:
+    """Mint a new request trace and return its root context, or None when
+    tracing is off (callers store the None and every later hop skips on
+    it). ``t0`` backdates the root to an earlier ``perf_counter`` reading
+    (e.g. admission entry) so pre-mint work lands inside the trace.
+    Retention is decided at :func:`finish_trace`: mode ``all`` keeps
+    everything, ``rate`` keeps a head-based coin flip drawn here, and
+    errored requests are always kept (the ``errors`` mode contract)."""
+    if not trace_on():
+        return None
+    global _TRACE_SEQ
+    perf = time.perf_counter()
+    wall = time.time()
+    if t0 is not None:
+        wall -= perf - t0
+        perf = t0
+    if _TRACE_MODE == "all":
+        sampled = True
+    elif _TRACE_MODE == "rate":
+        import random
+        sampled = random.random() < _TRACE_RATE
+    else:
+        sampled = False
+    with REGISTRY._lock:
+        _TRACE_SEQ += 1
+        trace_id = f"{os.getpid():x}-{_TRACE_SEQ:06d}"
+    tr = _Trace(trace_id, name, labels, wall, perf, sampled)
+    ctx = TraceContext(tr, "s0", True)
+    with REGISTRY._lock:
+        tr.nspans = 1
+        tr.spans["s0"] = {"id": "s0", "parent": None, "name": name,
+                          "t0_ms": 0.0, "dur_ms": None, "status": "open",
+                          "thread": threading.current_thread().name,
+                          **({"labels": labels} if labels else {})}
+    return ctx
+
+
+def finish_trace(ctx: TraceContext | None, error: str | None = None) -> None:
+    """Close a trace minted by :func:`start_trace` (idempotent): the root
+    span closes, the phase vector is completed to all :data:`PHASES` keys
+    and fed into the ``request_phase_ms{phase}`` rollups, and the trace is
+    retained (sampled, or ``error`` is set) or discarded."""
+    if ctx is None:
+        return
+    tr = ctx._tr
+    now = time.perf_counter()
+    with REGISTRY._lock:
+        if tr.done:
+            return
+        tr.done = True
+        tr.error = error
+        root = tr.spans["s0"]
+        if root["dur_ms"] is None:
+            root["dur_ms"] = round((now - tr.perf0) * 1e3, 6)
+            root["status"] = "error" if error else "ok"
+        for p in PHASES:
+            tr.phases.setdefault(p, 0.0)
+        keep = tr.sampled or error is not None
+        if keep:
+            REGISTRY._traces.append({
+                "trace_id": tr.trace_id, "name": tr.name,
+                "labels": tr.labels, "t0": tr.wall0,
+                "dur_ms": root["dur_ms"], "error": error,
+                "phases_ms": {p: round(v, 6) for p, v in
+                              sorted(tr.phases.items())},
+                "spans": list(tr.spans.values()),
+                "links": list(tr.links), "events": list(tr.events)})
+            drop = len(REGISTRY._traces) - _MAX_TRACES
+            if drop > 0:
+                del REGISTRY._traces[:drop]
+        phases = dict(tr.phases)
+    for p, ms in phases.items():
+        REGISTRY.observe_sampled("request_phase_ms", ms, phase=p)
+    REGISTRY.inc("trace_requests_total",
+                 outcome="error" if error else
+                 ("sampled" if tr.sampled else "unsampled"))
+
+
+def set_current_trace(ctxs) -> None:
+    """Bind the trace context(s) being worked for to the current thread
+    (a single context, an iterable, or None/empty to clear). Batchers
+    bind the whole batch before dispatch and MUST clear after the futures
+    resolve -- a pooled thread still holding finished traces is QT703."""
+    if ctxs is None:
+        tup = ()
+    elif isinstance(ctxs, TraceContext):
+        tup = (ctxs,)
+    else:
+        tup = tuple(c for c in ctxs if c is not None)
+    t = threading.current_thread()
+    REGISTRY._local.trace = tup
+    with REGISTRY._lock:
+        if tup:
+            REGISTRY._thread_traces[t.ident] = (t.name, tup)
+        else:
+            REGISTRY._thread_traces.pop(t.ident, None)
+
+
+def clear_current_trace() -> None:
+    """Unbind this thread's trace context(s) (see QT703)."""
+    set_current_trace(None)
+
+
+def current_trace() -> TraceContext | None:
+    """The innermost trace context bound to this thread, if any."""
+    cur = getattr(REGISTRY._local, "trace", ())
+    return cur[-1] if cur else None
+
+
+def current_traces() -> tuple:
+    """All trace contexts bound to this thread (a dispatching batcher
+    works for every traced request in the batch at once)."""
+    return getattr(REGISTRY._local, "trace", ())
+
+
+def trace_event_current(name: str, **fields) -> None:
+    """Record a point event on every trace bound to this thread (retry
+    attempts, degrades): no-op when nothing is bound."""
+    for ctx in current_traces():
+        ctx.event(name, **fields)
+
+
+def trace_thread_leaks() -> list:
+    """(thread_name, trace_id) pairs for threads whose bound contexts are
+    ALL finished -- the QT703 signal (context leaked across pooled-thread
+    reuse; the next request on that thread would inherit a dead trace)."""
+    with REGISTRY._lock:
+        items = list(REGISTRY._thread_traces.items())
+    leaks = []
+    for _tid, (tname, ctxs) in items:
+        if ctxs and all(c.done for c in ctxs):
+            leaks.append((tname, ctxs[-1].trace_id))
+    return leaks
+
+
+def traces() -> list:
+    """Retained finished traces (JSON-ready dicts, oldest first). Treat
+    as read-only; :func:`reset` drops them."""
+    with REGISTRY._lock:
+        return list(REGISTRY._traces)
+
+
+def chrome_trace_events(trs: list) -> list:
+    """Convert trace dicts (:func:`traces` / ``export_traces`` files) to
+    Chrome trace-event objects: one ``ph="X"`` complete event per span
+    (phase spans keep ``cat="phase"``), ``ph="s"/"f"`` flow events per
+    causal link, instants for trace events, and thread-name metadata.
+    Pure function -- ``tools/traceview.py --chrome`` uses it offline."""
+    events = [{"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
+               "args": {"name": "quest_tpu"}}]
+    tids: dict[str, int] = {}
+
+    def tid_of(thread_name):
+        tid = tids.get(thread_name)
+        if tid is None:
+            tid = tids[thread_name] = len(tids) + 1
+            events.append({"ph": "M", "pid": 0, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": thread_name}})
+        return tid
+
+    flow = 0
+    for t in trs:
+        base_us = t["t0"] * 1e6
+        by_id = {sp["id"]: sp for sp in t["spans"]}
+        for sp in t["spans"]:
+            events.append({
+                "ph": "X", "pid": 0, "tid": tid_of(sp.get("thread", "?")),
+                "name": sp["name"], "cat": sp.get("cat", "span"),
+                "ts": base_us + sp["t0_ms"] * 1e3,
+                "dur": (sp["dur_ms"] or 0.0) * 1e3,
+                "args": {"trace_id": t["trace_id"], "span_id": sp["id"],
+                         "status": sp.get("status", "ok"),
+                         **sp.get("labels", {})}})
+        for ln in t.get("links", ()):
+            a, b = by_id.get(ln["from"]), by_id.get(ln["to"])
+            if a is None or b is None:
+                continue
+            flow += 1
+            events.append({"ph": "s", "pid": 0,
+                           "tid": tid_of(a.get("thread", "?")),
+                           "id": flow, "name": ln["kind"], "cat": "link",
+                           "ts": base_us + a["t0_ms"] * 1e3})
+            events.append({"ph": "f", "bp": "e", "pid": 0,
+                           "tid": tid_of(b.get("thread", "?")),
+                           "id": flow, "name": ln["kind"], "cat": "link",
+                           "ts": base_us + b["t0_ms"] * 1e3})
+        for ev in t.get("events", ()):
+            sp = by_id.get(ev.get("span"))
+            events.append({
+                "ph": "i", "pid": 0, "s": "t",
+                "tid": tid_of((sp or {}).get("thread", "?")),
+                "name": ev["name"], "cat": "event",
+                "ts": base_us + ev["t_ms"] * 1e3,
+                "args": {"trace_id": t["trace_id"],
+                         **ev.get("fields", {})}})
+    return events
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write every retained trace as Perfetto-loadable Chrome trace-event
+    JSON (``{"traceEvents": [...]}``); returns the trace count."""
+    trs = traces()
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": chrome_trace_events(trs),
+                   "displayTimeUnit": "ms"}, fh)
+    return len(trs)
+
+
+def export_traces(path: str) -> int:
+    """Write the retained traces verbatim (``{"traces": [...]}``), the
+    ``tools/traceview.py`` input format; returns the trace count."""
+    trs = traces()
+    with open(path, "w") as fh:
+        json.dump({"traces": trs}, fh)
+    return len(trs)
+
+
+# ---------------------------------------------------------------------------
 # QUEST_TELEMETRY=0: swap the whole surface for no-op stubs at import, so a
 # disabled process pays nothing beyond one module import (no allocation, no
 # lock, no dict lookups -- the "zero-overhead-when-disabled" guarantee)
@@ -420,6 +988,15 @@ if not _ENV_ENABLED:  # pragma: no cover - exercised via subprocess test
     def _null_span(*args, **kwargs):
         return _NULL_SPAN
 
+    def _false(*args, **kwargs):
+        return False
+
+    def _empty_list(*args, **kwargs):
+        return []
+
+    def _empty_tuple(*args, **kwargs):
+        return ()
+
     inc = set_gauge = observe = event = reset = _noop  # noqa: F811
     span = _null_span                                  # noqa: F811
     counter_value = counter_total = _zero              # noqa: F811
@@ -433,3 +1010,26 @@ if not _ENV_ENABLED:  # pragma: no cover - exercised via subprocess test
 
     def events():                                      # noqa: F811
         return []
+
+    # tracing rides the same master switch: a telemetry-disabled process
+    # never traces, whatever QUEST_TRACE says (chrome_trace_events stays
+    # live -- it is a pure converter over already-exported files)
+    trace_on = _false                                                # noqa: F811
+    start_trace = finish_trace = current_trace = _noop               # noqa: F811
+    set_current_trace = clear_current_trace = _noop                  # noqa: F811
+    trace_event_current = _noop                                      # noqa: F811
+    current_traces = _empty_tuple                                    # noqa: F811
+    traces = trace_thread_leaks = _empty_list                        # noqa: F811
+
+    def trace_mode():                                  # noqa: F811
+        return "off"
+
+    @contextlib.contextmanager
+    def trace_policy(mode):                            # noqa: F811
+        yield
+
+    def export_chrome_trace(path):                     # noqa: F811
+        return 0
+
+    def export_traces(path):                           # noqa: F811
+        return 0

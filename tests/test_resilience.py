@@ -737,7 +737,11 @@ def test_injected_hang_without_watchdog_is_bounded_stall():
         with qt.explicit_mesh(ENV8.mesh):
             q = qt.createQureg(5, ENV8)
             qt.hadamard(q, 4)
-    assert time.monotonic() - t0 < 5.0
+    # the stall itself is HANG_SLEEP_S (0.1s); the budget absorbs the
+    # qureg build + dispatch around it, which on a loaded 1-core CI box
+    # alone can take several seconds -- the assertion only has to
+    # separate "bounded stall" from "eternal hang"
+    assert time.monotonic() - t0 < 30.0
     assert np.array_equal(want, np.asarray(q.amps))
 
 

@@ -2,7 +2,7 @@
 """Static-analysis CLI: run the plan verifier / ring checker / tape
 linter (quest_tpu.analysis, docs/analysis.md) from the command line.
 
-Four targets, one finding stream:
+Five targets, one finding stream:
 
   python tools/lint.py --bench-plans [--format json]
       Verify every bench.py --smoke plan config (plan_20q_relocation,
@@ -27,6 +27,12 @@ Four targets, one finding stream:
       and without the lock, QT604 raw threading primitives in code that
       must use the instrumented quest_tpu.resilience.sync layer. This
       is what the CI native gate runs.
+
+  python tools/lint.py --trace traces.json
+      Check an exported trace file (quest_tpu.telemetry.export_traces)
+      for QT702 span-integrity findings: a finished trace that still
+      carries an open span leaked an instrumentation handle. This is
+      what the CI trace-smoke gate runs over the dryrun's export.
 
 Exit status 1 when any error-severity finding is reported (the CI gate
 contract); warnings/info exit 0. ``--format json`` prints the
@@ -181,6 +187,9 @@ def main(argv=None) -> int:
                      default=None,
                      help="run the QT603/QT604 concurrency lints over "
                           "PATHS (default: the quest_tpu package)")
+    tgt.add_argument("--trace", metavar="FILE",
+                     help="check an export_traces JSON file for QT702 "
+                          "open-span findings")
     args = ap.parse_args(argv)
 
     _bootstrap_env(args.bench_plans)
@@ -195,6 +204,8 @@ def main(argv=None) -> int:
             findings += A.check_smoke_spec(spec)
     elif args.concurrency is not None:
         findings = A.lint_concurrency(args.concurrency or None)
+    elif args.trace:
+        findings = A.check_trace_file(args.trace)
     elif args.qasm:
         findings = _lint_circuit_fully(read_qasm(args.qasm),
                                        os.path.basename(args.qasm))

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Offline viewer for exported request traces (docs/observability.md).
+
+Input is an ``export_traces`` JSON file (``{"traces": [...]}``, written
+by ``quest_tpu.telemetry.export_traces`` or the dryrun trace-smoke).
+Three views:
+
+  python tools/traceview.py traces.json
+      Top-N slowest requests (default 10, ``--top N``): end-to-end
+      latency, per-phase breakdown, span/link counts, error tag.
+
+  python tools/traceview.py traces.json --phases
+      Aggregate per-phase table over every trace in the file: p50 / p95
+      / p99 / max milliseconds per canonical phase, plus the
+      phases-sum-vs-e2e attribution coverage (the bench rows assert the
+      same ratio stays within 10%).
+
+  python tools/traceview.py traces.json --chrome out.json
+      Convert to Perfetto-loadable Chrome trace-event JSON
+      (``quest_tpu.telemetry.chrome_trace_events``; load at
+      https://ui.perfetto.dev or chrome://tracing).
+
+Works on any export regardless of telemetry env state -- the converter
+is a pure function over the trace dicts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: canonical phase order (mirrors quest_tpu.telemetry.PHASES without
+#: importing it at parse time -- the file format is the contract)
+PHASE_ORDER = ("queue_wait", "coalesce", "cache_lookup", "compile",
+               "dispatch", "device", "resolve")
+
+
+def load_traces(path: str) -> list:
+    with open(path) as f:
+        doc = json.load(f)
+    trs = doc.get("traces", []) if isinstance(doc, dict) else doc
+    if not isinstance(trs, list):
+        raise SystemExit(f"{path}: not an export_traces file")
+    return trs
+
+
+def _pct(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * len(sorted_vals)))]
+
+
+def _phase_keys(trs: list) -> list:
+    keys = [p for p in PHASE_ORDER
+            if any(p in t.get("phases_ms", {}) for t in trs)]
+    extra = sorted({p for t in trs for p in t.get("phases_ms", {})}
+                   - set(PHASE_ORDER))
+    return keys + extra
+
+
+def show_slowest(trs: list, top: int) -> None:
+    trs = sorted(trs, key=lambda t: t.get("dur_ms", 0.0), reverse=True)
+    print(f"# {len(trs)} trace(s); top {min(top, len(trs))} by latency")
+    for t in trs[:top]:
+        labels = t.get("labels", {})
+        tag = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        err = f"  ERROR={t['error']}" if t.get("error") else ""
+        print(f"\n{t['trace_id']}  {t.get('dur_ms', 0.0):10.3f} ms  "
+              f"{t.get('name', '?')}{('  [' + tag + ']') if tag else ''}"
+              f"{err}")
+        phases = t.get("phases_ms", {})
+        total = sum(phases.values())
+        for p in _phase_keys([t]):
+            ms = phases.get(p, 0.0)
+            share = 100.0 * ms / total if total else 0.0
+            print(f"    {p:<12} {ms:10.3f} ms  {share:5.1f}%")
+        dur = t.get("dur_ms", 0.0)
+        cov = 100.0 * total / dur if dur else 0.0
+        print(f"    {'(coverage)':<12} {total:10.3f} ms  {cov:5.1f}% of "
+              f"e2e; {len(t.get('spans', ()))} span(s), "
+              f"{len(t.get('links', ()))} link(s)")
+
+
+def show_phases(trs: list) -> None:
+    if not trs:
+        print("# no traces")
+        return
+    print(f"# per-phase latency over {len(trs)} trace(s), ms")
+    print(f"{'phase':<14}{'p50':>10}{'p95':>10}{'p99':>10}{'max':>10}")
+    for p in _phase_keys(trs):
+        vals = sorted(t.get("phases_ms", {}).get(p, 0.0) for t in trs)
+        print(f"{p:<14}{_pct(vals, 0.50):>10.3f}{_pct(vals, 0.95):>10.3f}"
+              f"{_pct(vals, 0.99):>10.3f}{vals[-1]:>10.3f}")
+    fracs = sorted(
+        sum(t["phases_ms"].values()) / t["dur_ms"]
+        for t in trs if t.get("dur_ms") and t.get("phases_ms"))
+    if fracs:
+        print(f"\n# attribution coverage (sum(phases)/e2e): "
+              f"min={fracs[0]:.3f} p50={_pct(fracs, 0.5):.3f} "
+              f"max={fracs[-1]:.3f}")
+
+
+def write_chrome(trs: list, out: str) -> None:
+    from quest_tpu.telemetry import chrome_trace_events
+    with open(out, "w") as f:
+        json.dump({"traceEvents": chrome_trace_events(trs),
+                   "displayTimeUnit": "ms"}, f)
+    print(f"# wrote {out}: {len(trs)} trace(s) "
+          f"(load at https://ui.perfetto.dev)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("file", help="export_traces JSON file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slowest requests to show (default 10)")
+    ap.add_argument("--phases", action="store_true",
+                    help="aggregate per-phase p50/p95/p99 table")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="convert to Chrome trace-event JSON at OUT")
+    args = ap.parse_args(argv)
+    trs = load_traces(args.file)
+    if args.chrome:
+        write_chrome(trs, args.chrome)
+    elif args.phases:
+        show_phases(trs)
+    else:
+        show_slowest(trs, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
