@@ -970,11 +970,82 @@ class HedgeRaceScenario(_ScenarioBase):
         ctx["pool"].close(drain=False)
 
 
+class AsyncDispatchDrainScenario(_ScenarioBase):
+    """Async dispatch racing ``close(drain=True)`` on a completion-ring
+    engine (round 18): two clients submit while a closer drains. Ring
+    admission separates ISSUE from RESOLUTION, so the close path must
+    retire every admitted entry before the batcher exits -- an exit
+    condition that forgets the ring strands resolved-on-device work in
+    unresolved futures. Invariants: each submission ends served
+    bit-identically, cancelled typed, or rejected typed -- never hung,
+    never untyped -- and the ring is empty once close returns."""
+
+    name = "async_dispatch_drain"
+
+    def setup(self) -> dict:
+        from ..engine.engine import Engine
+
+        return {"eng": Engine(self.circ, async_depth=2, **self.engine_kw),
+                "out": {}}
+
+    def threads(self, ctx: dict) -> list:
+        from ..resilience.errors import QuESTCancelledError
+
+        eng, out = ctx["eng"], ctx["out"]
+
+        def submitter(slot: str, params: dict):
+            def submit() -> None:
+                try:
+                    fut = eng.submit(params)
+                except RuntimeError as e:
+                    out[slot] = ("rejected", str(e))
+                    return
+                try:
+                    out[slot] = ("served", await_future(fut))
+                except QuESTCancelledError:
+                    out[slot] = ("cancelled", None)
+            return submit
+
+        def close() -> None:
+            eng.close(drain=True)
+            out["ring_after_close"] = len(eng._ring)
+
+        return [("t0-submitA", submitter("a", _PARAMS_A)),
+                ("t1-submitB", submitter("b", _PARAMS_B)),
+                ("t2-close", close)]
+
+    def check(self, ctx: dict) -> List[str]:
+        out = ctx["out"]
+        breaches: List[str] = []
+        for slot in ("a", "b"):
+            rec = out.get(slot)
+            if rec is None:
+                breaches.append(f"submit {slot!r} recorded no outcome")
+                continue
+            kind, val = rec
+            if kind == "served":
+                breaches += self._bitcheck(f"submit {slot!r}", val, slot)
+            elif kind not in ("cancelled", "rejected"):
+                breaches.append(f"unexpected submit outcome {kind!r}")
+        ring = out.get("ring_after_close")
+        if ring is None:
+            breaches.append("close thread recorded no outcome")
+        elif ring:
+            breaches.append(
+                f"{ring} completion-ring entr{'y' if ring == 1 else 'ies'} "
+                "survived close(drain=True)")
+        return breaches
+
+    def teardown(self, ctx: dict) -> None:
+        ctx["eng"].close(drain=False)
+
+
 #: name -> scenario class, the explorer's production scenario registry
 SCENARIOS = {
     EngineCloseRaceScenario.name: EngineCloseRaceScenario,
     PoolFailoverRaceScenario.name: PoolFailoverRaceScenario,
     HedgeRaceScenario.name: HedgeRaceScenario,
+    AsyncDispatchDrainScenario.name: AsyncDispatchDrainScenario,
 }
 
 

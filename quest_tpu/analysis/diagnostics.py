@@ -179,6 +179,12 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "QUEST_HEDGE_MS and QUEST_TENANT_QPS must be integers >= 0 "
               "(0 disables hedging / the quota); the malformed value was "
               "replaced"),
+    "QT310": ("warning", "QUEST_ASYNC_DEPTH is malformed or out of range",
+              "set QUEST_ASYNC_DEPTH to 0 (synchronous dispatch: the "
+              "batcher drains each batch before issuing the next) or a "
+              "positive integer completion-ring depth (default 2: up to "
+              "that many batches in flight on the device while the host "
+              "coalesces the next); the malformed value was replaced"),
     # -- QT4xx: integrity sentinels / self-healing (docs/resilience.md) -----
     "QT401": ("error", "total-probability drift beyond the precision "
                        "tolerance band",
@@ -266,6 +272,14 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "pair every set_current_trace with clear_current_trace "
               "after future resolution, or the next request dispatched "
               "on that thread inherits a dead trace"),
+    "QT704": ("warning", "request phase vector does not tile its "
+                         "end-to-end latency within 10%",
+              "the union of the trace's canonical phase windows (overlap "
+              "between dispatch and device counted once -- async "
+              "dispatch legitimately overlaps them) covers less than 90% "
+              "or more than 110% of the request's wall-clock: an "
+              "instrumentation site is missing a phase attribution or "
+              "double-counting one"),
 }
 
 

@@ -17,6 +17,20 @@ Two invariants guard the round-17 span trees
   would be adopted into a dead trace -- cross-request attribution, the
   tracing analogue of the QT603 torn-state lint. Dispatch loops must
   pair every bind with :func:`~quest_tpu.telemetry.clear_current_trace`.
+- **QT704 -- phase vector does not tile the request (overlap-aware,
+  round 18)**: a request carrying the full canonical phase vector whose
+  phase COVERAGE falls outside [90%, 110%] of its end-to-end latency.
+  Coverage is the UNION of the trace's phase span windows
+  (:func:`phase_coverage`), NOT their sum: under the async dispatch
+  pipeline the ``dispatch`` and ``device`` phases legitimately overlap
+  across the launch-call window (the host is still inside the issuing
+  call while the device already executes), so a plain
+  ``sum(phases_ms)/dur_ms`` over-counts the shared interval and would
+  false-positive on exactly the requests the pipeline is helping.
+  Counting every overlapped instant once restores the tiling invariant:
+  less than 90% coverage means an instrumentation site dropped a phase
+  attribution, more than 110% means one double-counted outside a
+  legitimate overlap.
 
 Reachable three ways, like every checker in this package: the
 ``tools/lint.py --trace FILE`` CLI (over an
@@ -31,7 +45,72 @@ import json
 
 from .diagnostics import Finding, make_finding
 
-__all__ = ["check_traces", "check_live_traces", "check_trace_file"]
+__all__ = ["PHASES", "phase_coverage", "check_phase_tiling",
+           "check_traces", "check_live_traces", "check_trace_file"]
+
+#: the canonical request phase vector (round 17; docs/serving.md) --
+#: traces carrying ALL of these are subject to the QT704 tiling check
+PHASES = ("queue_wait", "coalesce", "cache_lookup", "compile", "dispatch",
+          "device", "resolve")
+
+
+def phase_coverage(tr) -> float | None:
+    """Fraction of a finished trace's end-to-end latency covered by the
+    UNION of its canonical phase windows (overlap counted once -- the
+    async dispatch/device overlap rule, QT704). Reads the per-span
+    ``cat="phase"`` entries for window positions; a trace whose spans are
+    absent (older export, or a hand-built dict) falls back to the plain
+    ``sum(phases_ms)/dur_ms`` ratio -- correct whenever phases don't
+    overlap, i.e. everywhere the span-less form predates the async
+    pipeline. Returns None when the trace has no duration or no phase
+    data at all."""
+    dur = tr.get("dur_ms")
+    if not dur:
+        return None
+    spans = [sp for sp in tr.get("spans", ())
+             if sp.get("cat") == "phase" and sp.get("name") in PHASES
+             and sp.get("t0_ms") is not None
+             and sp.get("dur_ms") is not None]
+    if not spans:
+        phases = tr.get("phases_ms")
+        if not phases:
+            return None
+        return sum(phases.values()) / dur
+    ivals = sorted((sp["t0_ms"], sp["t0_ms"] + sp["dur_ms"])
+                   for sp in spans)
+    covered = 0.0
+    cur_a, cur_b = ivals[0]
+    for a, b in ivals[1:]:
+        if a <= cur_b:
+            cur_b = max(cur_b, b)
+        else:
+            covered += cur_b - cur_a
+            cur_a, cur_b = a, b
+    covered += cur_b - cur_a
+    return covered / dur
+
+
+def check_phase_tiling(trs, location: str = "traces") -> list:
+    """QT704 over finished trace dicts: one finding per trace that
+    carries the FULL canonical phase vector (partial vectors -- error
+    paths, non-request traces -- are out of scope; a missing phase there
+    is expected, not a tiling breach) whose :func:`phase_coverage` falls
+    outside [0.9, 1.1]."""
+    findings: list[Finding] = []
+    for tr in trs:
+        phases = tr.get("phases_ms") or {}
+        if not all(p in phases for p in PHASES):
+            continue
+        frac = phase_coverage(tr)
+        if frac is None or 0.9 <= frac <= 1.1:
+            continue
+        findings.append(make_finding(
+            "QT704",
+            f"trace {tr.get('trace_id')} phase union covers "
+            f"{frac * 100.0:.1f}% of its {tr['dur_ms']:.3f}ms end-to-end "
+            f"latency (expected 90-110%)",
+            f"{location}.{tr.get('trace_id')}"))
+    return findings
 
 
 def check_traces(trs, location: str = "traces") -> list:
@@ -62,6 +141,7 @@ def check_live_traces(location: str = "telemetry") -> list:
     teardown tests call this after the fleet quiesces."""
     from .. import telemetry
     findings = check_traces(telemetry.traces(), location=location)
+    findings += check_phase_tiling(telemetry.traces(), location=location)
     for tname, trace_id in telemetry.trace_thread_leaks():
         findings.append(make_finding(
             "QT703",
@@ -73,10 +153,11 @@ def check_live_traces(location: str = "telemetry") -> list:
 
 
 def check_trace_file(path: str, location: str | None = None) -> list:
-    """QT702 over an :func:`~quest_tpu.telemetry.export_traces` JSON file
-    (``{"traces": [...]}``; a bare list is accepted too) -- the
+    """QT702 + QT704 over an :func:`~quest_tpu.telemetry.export_traces`
+    JSON file (``{"traces": [...]}``; a bare list is accepted too) -- the
     ``tools/lint.py --trace`` entry point."""
     with open(path) as f:
         doc = json.load(f)
     trs = doc.get("traces", []) if isinstance(doc, dict) else doc
-    return check_traces(trs, location=location or path)
+    return (check_traces(trs, location=location or path)
+            + check_phase_tiling(trs, location=location or path))

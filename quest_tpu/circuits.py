@@ -633,6 +633,19 @@ class Circuit:
         return segments.chain_executable(self, max_items=max_items,
                                          donate=donate)
 
+    def compiled_request(self, donate: bool = True, reduce=None):
+        """The WHOLE request -- every frame-identity segment plus an
+        optional final traceable ``reduce(amps)`` -- composed into ONE
+        dispatched program with the state buffer donated end-to-end
+        (round 18, :func:`quest_tpu.segments.request_executable`).
+        ``dispatches_per_circuit`` hits its floor of 1: calling the
+        returned executable counts exactly one
+        ``device_dispatch_total{route="request"}`` however many segments
+        (``.num_segments``) were composed."""
+        from . import segments
+        return segments.request_executable(self, donate=donate,
+                                           reduce=reduce)
+
     def run(self, qureg: Qureg) -> Qureg:
         """Apply the circuit to ``qureg`` (mutates its amps, like the C API).
 

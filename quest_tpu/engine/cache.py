@@ -68,6 +68,15 @@ class LRUCache:
         with self._lock:
             return key in self._od
 
+    def peek(self, key, default=None):
+        """Non-mutating probe: no recency refresh, no hit/miss counters,
+        no eviction-order side effects. The pool's ahead-of-demand
+        precompiler (round 18) uses this to classify a fingerprint as
+        already-warm without promoting it over entries live traffic is
+        actually using."""
+        with self._lock:
+            return self._od.get(key, default)
+
     def get(self, key, default=None):
         """Telemetered lookup (hit/miss counted, recency refreshed)."""
         with self._lock:

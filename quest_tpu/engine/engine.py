@@ -74,9 +74,46 @@ Health states (ISSUE 8 -- engine-level, fed by the integrity machinery):
   ``healthy``; transitions count
   ``engine_health_transitions_total{from,to}``.
 
+Async dispatch pipeline (round 18 -- the host-side twin of PR 8's
+prologue/steady-state/epilogue collective pipeline):
+
+- **Host/device overlap**: with ``async_depth >= 1`` (ctor arg /
+  ``QUEST_ASYNC_DEPTH``, default 2, QT310 warn-once) the batcher never
+  blocks between the queue and the device -- it issues the traced vmap
+  program for batch k, parks the in-flight result in a bounded
+  **completion ring**, and immediately returns to coalescing batch k+1
+  while k executes. Ring entries retire (device sync + per-lane future
+  resolution) when the ring is full, when the queue idles, and at
+  close; a retire-time device error/hang/breach is attributed to the
+  RING ENTRY's requests, never to the batch being issued
+  (``engine_async_retires_total{outcome}``, ``engine_async_inflight``).
+  ``async_depth=0`` restores strictly synchronous dispatch -- the A/B
+  baseline; both routes run the identical padded executable, so async
+  and sync results are bit-identical by construction.
+- **Serial issue on timeshared backends**: XLA:CPU executes
+  concurrently enqueued programs by timesharing the same host cores
+  (no private execution stream), so running two batch programs ahead
+  of each other costs ~20% per batch -- more than the host time it
+  hides. On CPU, ring admission therefore device-syncs the in-flight
+  head before the next issue and -- when a spare host core exists --
+  defers its RESOLUTION until just after it: assembly and coalescing
+  overlap device execution on the way in, lane extraction and future
+  resolution on the way out, and the device never timeshares two
+  batches. On a single-core host there is nothing to overlap (the
+  "overlapped" host thread is starved by the execution thread), so
+  the head resolves before the issue. Admission and settling run
+  outside the dispatch watchdog; each blocking sync is bounded by its
+  own ``engine.retire`` deadline and charged to the entry it retires.
+- **Continuous batching** (Orca, PAPERS.md): while a batch is in
+  flight, the device -- not the ``max_delay_ms`` timer -- paces the
+  window: a late submit joins the NEXT vmap window instead of waiting
+  out a full coalescing tick (the padded fixed-shape program makes the
+  join point well-defined).
+
 Lifecycle: construct, optionally :meth:`warmup`, ``submit``/``run``, then
-:meth:`close` -- which drains the queue (every accepted future resolves)
-and joins the batcher thread. The engine is also a context manager.
+:meth:`close` -- which drains the queue AND the completion ring (every
+accepted future resolves) and joins the batcher thread. The engine is
+also a context manager.
 """
 
 from __future__ import annotations
@@ -87,6 +124,8 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
+
+import numpy as np
 
 from .. import telemetry
 from ..resilience import faultinject as _faults
@@ -127,6 +166,23 @@ class _Request:
         self.trace = trace
 
 
+_ASYNC_ENV = "QUEST_ASYNC_DEPTH"
+_ASYNC_ENV_WARNED: set = set()
+
+
+def async_depth_default() -> int:
+    """``QUEST_ASYNC_DEPTH`` (default 2): completion-ring depth of the
+    async dispatch pipeline -- how many issued batches may be in flight on
+    the device while the host coalesces the next. ``0`` means synchronous
+    dispatch (the batcher drains each batch before issuing another -- the
+    A/B baseline the bench compares against). Malformed or negative values
+    fall back through :func:`parse_env_int` with a QT310 warn-once."""
+    from ..analysis.diagnostics import parse_env_int
+    return parse_env_int(_ASYNC_ENV, 2, minimum=0, code="QT310",
+                         warned=_ASYNC_ENV_WARNED,
+                         noun="async completion-ring depth")
+
+
 def _env_queue_max() -> int:
     """``QUEST_ENGINE_QUEUE_MAX`` (0/unset = unbounded); malformed values
     fall back to unbounded with a QT303 diagnostic."""
@@ -158,7 +214,8 @@ class Engine:
     def __init__(self, circuit, env=None, *, precision_code: int | None = None,
                  max_batch: int = 8, max_delay_ms: float = 2.0,
                  initial="zero", donate: bool = True,
-                 queue_max: int | None = None):
+                 queue_max: int | None = None,
+                 async_depth: int | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -173,6 +230,12 @@ class Engine:
             queue_max = _env_queue_max()
         if queue_max < 0:
             raise ValueError(f"queue_max must be >= 0, got {queue_max}")
+        if async_depth is None:
+            async_depth = async_depth_default()
+        if async_depth < 0:
+            raise ValueError(f"async_depth must be >= 0, got {async_depth}")
+        #: completion-ring depth; 0 = synchronous dispatch (A/B baseline)
+        self.async_depth = int(async_depth)
         #: pending-queue bound; 0 = unbounded (the pre-ISSUE-7 behavior)
         self.queue_max = int(queue_max)
         self.circuit = circuit
@@ -214,6 +277,16 @@ class Engine:
         self.fingerprint = circuit.fingerprint()
         self._cv = _sync.Condition("engine.cv")
         self._q: deque = deque()
+        # completion ring (round 18): in-flight issued batches awaiting
+        # their device sync. BATCHER-THREAD-ONLY -- submit/close never
+        # touch it, so it needs no lock; the loop drains it before exit.
+        # Entries are [out, batch, tick, dev_t0, t_ready]: t_ready flips
+        # from None when the serial-issue admission proved the device
+        # done (the entry is then "synced" and its resolution is
+        # deliberately deferred past the next issue).
+        self._ring: deque = deque()
+        self._serial: bool | None = None  # resolved lazily by _issue_serial
+        self._cores: int | None = None  # resolved lazily by _spare_core
         self._open = True
         self._health = "healthy"
         self._breaches = 0        # sentinel breaches since last full heal
@@ -230,7 +303,7 @@ class Engine:
                               if s.kind == _SEED)
         telemetry.event("engine.start", fingerprint=self.fingerprint[:12],
                         nsv=nsv, max_batch=self.max_batch,
-                        sharded=self.sharded,
+                        sharded=self.sharded, async_depth=self.async_depth,
                         params=len(self._lifted.param_names),
                         seed_slots=self.seed_slots)
 
@@ -484,23 +557,36 @@ class Engine:
     def _loop(self) -> None:
         while True:
             with self._cv:
-                while not self._q and self._open:
+                while not self._q and self._open and not self._ring:
                     self._cv.wait()
                 if not self._q:
-                    return  # closed and fully drained
-                batch = [self._q.popleft()]
-                deadline = time.perf_counter() + self.max_delay_s
-                while len(batch) < self.max_batch:
-                    if self._q:
-                        batch.append(self._q.popleft())
-                        continue
-                    if not self._open:
-                        break
-                    remaining = deadline - time.perf_counter()
-                    if remaining <= 0:
-                        break
-                    self._cv.wait(remaining)
-                telemetry.set_gauge("engine_queue_depth", len(self._q))
+                    if not self._ring:
+                        return  # closed and fully drained (queue AND ring)
+                    batch = None  # idle (or closing) with work in flight
+                else:
+                    batch = [self._q.popleft()]
+                    deadline = time.perf_counter() + self.max_delay_s
+                    while len(batch) < self.max_batch:
+                        if self._q:
+                            batch.append(self._q.popleft())
+                            continue
+                        if not self._open:
+                            break
+                        remaining = deadline - time.perf_counter()
+                        # continuous batching (round 18): with a batch in
+                        # flight the device, not the timer, paces the
+                        # window -- issue what we have and let a late
+                        # submit join the NEXT vmap window
+                        if remaining <= 0 or self._ring:
+                            break
+                        self._cv.wait(remaining)
+                    telemetry.set_gauge("engine_queue_depth", len(self._q))
+            if batch is None:
+                # queue idle but batches in flight: retire the oldest ring
+                # entry (its futures resolve) before sleeping -- the ring
+                # never outlives the loop and never waits on new traffic
+                self._retire_oldest()
+                continue
             live = self._expire(batch)
             if live:
                 # t_first (the pop instant) is recovered from the already
@@ -565,18 +651,44 @@ class Engine:
                 tr.phase("queue_wait", req.t0, max(0.0, pivot - req.t0))
                 tr.phase("coalesce", pivot, max(0.0, t_close - pivot))
             telemetry.set_current_trace(traced)
-        # the injectable hang point: one visit per dispatch; with
+        # the injectable hang/transient point: one visit per dispatch; with
         # QUEST_WATCHDOG_MS armed the WHOLE dispatch (tracing included --
         # it begins and ends on the watchdog's worker thread, so jax's
         # thread-local trace state never splits) is deadline-bounded
-        hang = (_faults.enabled()
-                and _faults.fire("engine.dispatch") == "hang")
+        kind = _faults.fire("engine.dispatch") if _faults.enabled() else None
+        ringable = (mode == "vmap" and self.async_depth > 0
+                    and bool(self._lifted.slots))
+        deferred = False
         try:
             with telemetry.span("engine.dispatch", mode=mode,
                                 batch=len(batch)):
-                _watchdog.watched(
-                    lambda: self._dispatch_one(batch, mode),
-                    site="engine.dispatch", hang=hang)
+                if kind == "transient":
+                    # an injected issue-time transient fails THIS batch
+                    # before it reaches the device (or the completion
+                    # ring): the bisection ladder below re-dispatches it,
+                    # so healthy requests still complete and attribution
+                    # never leaks onto a different in-flight batch
+                    from ..resilience.errors import TransientFault
+                    raise TransientFault("engine.dispatch", kind)
+                if ringable:
+                    # ring admission runs OUTSIDE the dispatch watchdog:
+                    # each retire is its own deadline-bounded blocking
+                    # boundary (guard.device_sync), so a retire-time hang
+                    # is charged to the RETIRED entry -- wrapping it in
+                    # this batch's dispatch deadline would misattribute
+                    # the wedge to the batch being issued. The wait for
+                    # ring capacity is this batch's queue_wait.
+                    t_adm = time.perf_counter() if traced else 0.0
+                    self._ring_admit()
+                    if traced:
+                        t_adm1 = time.perf_counter()
+                        for req in batch:
+                            if req.trace is not None and t_adm1 > t_adm:
+                                req.trace.phase("queue_wait", t_adm,
+                                                t_adm1 - t_adm)
+                deferred = _watchdog.watched(
+                    lambda: self._dispatch_one(batch, mode, defer=True),
+                    site="engine.dispatch", hang=(kind == "hang"))
         except QuESTHangError as e:
             # no bisection: a wedged dispatch would wedge each half too;
             # fail the batch typed and quarantine the engine
@@ -604,22 +716,40 @@ class Engine:
                 _sync.resolve_future(req.fut, exception=e,
                                      site="engine.dispatch")
         else:
-            self._note_clean()
+            # a deferred batch is merely ISSUED: health credit and latency
+            # observation move to its ring retire, where the device sync
+            # actually proves the dispatch clean
+            if not deferred:
+                self._note_clean()
         finally:
             if traced:
                 telemetry.clear_current_trace()
+        if deferred:
+            # entries the admission proved complete resolve only NOW,
+            # after the issue: their lane extraction, sentinel gate and
+            # future resolution overlap the batch just put on the device
+            # instead of holding it idle
+            self._ring_settle()
+            return
         now = time.perf_counter()
         for req in batch:
             telemetry.observe("engine_request_latency_seconds", now - req.t0)
 
-    def _dispatch_one(self, batch: list, mode: str) -> None:
+    def _dispatch_one(self, batch: list, mode: str,
+                      defer: bool = False) -> bool:
+        """Run one batch on its route. Returns True when the batch was
+        ISSUED onto the completion ring (async vmap path -- its futures
+        resolve at retire), False when it was fully dispatched and
+        resolved synchronously. ``defer=False`` (the bisection ladder's
+        calls) forces the synchronous route: a re-dispatched half must
+        resolve before the ladder recurses, never re-enter the ring."""
         # device dispatch is a blocking boundary: flight-record QT602 if
         # any instrumented lock is still held on the dispatching thread
         _sync.guard_blocking("engine.dispatch")
         if mode == "vmap":
-            self._dispatch_vmap(batch)
-        else:
-            self._dispatch_sequential(batch)
+            return self._dispatch_vmap(batch, defer=defer)
+        self._dispatch_sequential(batch)
+        return False
 
     def _bisect(self, batch: list, mode: str, _prev: dict | None = None) -> None:
         telemetry.inc("engine_bisections_total")
@@ -657,19 +787,23 @@ class Engine:
                 for sp in spans.values():
                     sp.end()
 
-    def _sentinel_gate(self, amps) -> None:
+    def _sentinel_gate(self, amps, tick: int | None = None) -> None:
         """Check one dispatch result against the armed sentinel policy
         (no-op boolean when ``QUEST_SENTINEL`` is off); raises
         QuESTIntegrityError rather than letting a corrupt state reach its
         future. The ``state.corrupt`` injection visit happens here too, so
-        SDC tests corrupt real results, not synthetic arrays."""
+        SDC tests corrupt real results, not synthetic arrays. A ring
+        retire passes the ISSUING dispatch's ordinal as ``tick`` so the
+        sentinel tick tracks the batch being checked, not whatever the
+        host has issued since."""
         if not _sentinel.enabled():
             return amps
         findings = _sentinel.check_amps(
             amps, density=self.circuit.is_density_matrix,
             n=self.circuit.num_qubits,
             mesh=self._mesh if self.sharded else None,
-            tick=self._dispatches, where="engine.dispatch")
+            tick=self._dispatches if tick is None else tick,
+            where="engine.dispatch")
         if findings:
             raise QuESTIntegrityError(
                 "dispatch result breached the integrity sentinels: "
@@ -772,7 +906,7 @@ class Engine:
             _sync.resolve_future(req.fut, result=res,
                                  site="engine.dispatch")
 
-    def _dispatch_vmap(self, batch: list) -> None:
+    def _dispatch_vmap(self, batch: list, defer: bool = False) -> bool:
         import jax.numpy as jnp
 
         for req in batch:
@@ -816,13 +950,24 @@ class Engine:
                     self._trace_done(req, rt, time.perf_counter())
                 _sync.resolve_future(req.fut, result=out,
                                      site="engine.dispatch")
-            return
+            return False
+        # async pipeline: ring admission (eager retires, the in-flight
+        # bound, the serial-issue gate) already ran in _dispatch, outside
+        # the dispatch watchdog -- this method only assembles and issues
+        defer = defer and self.async_depth > 0
         # host-side batch assembly (pad to the fixed vmap shape): on the
-        # traced path this lands in the dispatch phase
+        # traced path this lands in the dispatch phase. The per-slot
+        # stacks are NUMPY, not jnp -- each jnp.stack is its own device
+        # computation, the PJRT CPU client bounds in-flight computations
+        # (32), and a slot-rich ansatz issuing one stack per slot behind
+        # an in-flight batch blows that bound: the "async" issue then
+        # silently blocks for a full device execution. Host stacking
+        # enters the program as plain transfers (bitwise the same lanes)
+        # and keeps the whole batch at ~two enqueued computations.
         t_asm = time.perf_counter() if traced else 0.0
         pad = self.max_batch - len(batch)
         vals = [req.values for req in batch] + [batch[-1].values] * pad
-        stacked = tuple(jnp.stack([v[k] for v in vals])
+        stacked = tuple(np.stack([np.asarray(v[k]) for v in vals])
                         for k in range(len(self._lifted.slots)))
         amps_b = jnp.repeat(self.initial_amps[None], self.max_batch, axis=0)
         t_a = time.perf_counter() if traced else 0.0
@@ -836,6 +981,32 @@ class Engine:
         # the whole coalesced batch is ONE vmap program launch
         telemetry.inc("device_dispatch_total", route="engine_vmap")
         out = fnB(amps_b, stacked)
+        if defer:
+            # ASYNC ISSUE: park the in-flight result on the completion
+            # ring and return to coalescing -- the device executes batch k
+            # while the host assembles batch k+1. Futures resolve at
+            # retire; so do health credit and latency observation.
+            t_c = time.perf_counter() if traced else 0.0
+            dev_t0 = 0.0
+            if traced:
+                retraced = telemetry.counter_value(
+                    "engine_trace_total", kind="param_replay") > before
+                # jit COMPILE is synchronous at the call site, so a
+                # retraced launch begins device work only at t_c; a warm
+                # launch overlaps device execution with the launch-call
+                # window [t_b, t_c] -- the dispatch and device phases
+                # then legitimately overlap there, and the QT704 union
+                # rule counts the shared window once
+                dev_t0 = t_c if retraced else t_b
+                for req in traced:
+                    tr = req.trace
+                    tr.phase("cache_lookup", t_a, t_b - t_a)
+                    tr.phase("dispatch", t_asm, t_a - t_asm)
+                    tr.phase("compile" if retraced else "dispatch",
+                             t_b, t_c - t_b)
+            self._ring.append([out, batch, self._dispatches, dev_t0, None])
+            telemetry.set_gauge("engine_async_inflight", len(self._ring))
+            return True
         if traced:
             t_c = time.perf_counter()
             jax.block_until_ready(out)
@@ -849,6 +1020,13 @@ class Engine:
                 tr.phase("compile" if retraced else "dispatch",
                          t_b, t_c - t_b)
                 tr.phase("device", t_c, t_d - t_c)
+        elif self.async_depth == 0:
+            # TRUE synchronous baseline: async_depth=0 drains each batch
+            # before resolving it -- the batcher never runs ahead of the
+            # device, the A/B floor the serve bench compares the
+            # completion ring against
+            import jax
+            jax.block_until_ready(out)
         # each request's resolve phase runs from the device sync to ITS
         # resolution: lane extraction (a compiled slice on the first
         # run), the sentinel gate, and the wait behind earlier lanes.
@@ -861,3 +1039,200 @@ class Engine:
                 self._trace_done(req, t_d, time.perf_counter())
             _sync.resolve_future(req.fut, result=lane,
                                  site="engine.dispatch")
+        return False
+
+    def _fail_batch(self, batch: list, exc, *, site: str) -> None:
+        """Resolve every still-pending future in ``batch`` with ``exc``
+        (already-resolved lanes -- e.g. the ones a retire served before a
+        later lane breached -- are left alone)."""
+        for req in batch:
+            if req.fut.done():
+                continue
+            self._trace_error(req, exc)
+            _sync.resolve_future(req.fut, exception=exc, site=site)
+
+    def _ring_head_ready(self) -> bool:
+        """Non-blocking poll: has the device finished the OLDEST in-flight
+        batch? Drives eager retirement -- the batcher resolves completed
+        work between issues instead of parking it until the ring's
+        backpressure bound forces a (then-instant) sync. A buffer without
+        a readiness probe counts as ready: retiring it blocks no longer
+        than the probe-less sync path always did."""
+        out = self._ring[0][0]
+        probe = getattr(out, "is_ready", None)
+        if probe is None:
+            return True
+        try:
+            return bool(probe())
+        except Exception:  # pragma: no cover - deleted/donated buffer
+            return True
+
+    def _issue_serial(self) -> bool:
+        """Whether issue must wait for the in-flight batch's device sync.
+
+        XLA:CPU has no private execution stream: two concurrently
+        enqueued batch programs EXECUTE concurrently, timesharing the
+        same host cores (measured ~20% per-batch throughput penalty with
+        two large batches in flight), so running ahead of the device
+        costs more than the host time it hides. On CPU the pipeline
+        therefore still overlaps assembly, coalescing and resolution
+        with device execution but never two batch programs with each
+        other. Stream-ordered backends (TPU/GPU) queue enqueued work in
+        hardware order -- there ``async_depth`` alone governs."""
+        s = self._serial
+        if s is None:
+            import jax
+
+            s = self._serial = jax.default_backend() == "cpu"
+        return s
+
+    def _spare_core(self) -> bool:
+        """Whether a host core is free while the device executes -- the
+        precondition for deferring resolution past the next issue. On a
+        single-core host the batcher thread and the XLA execution
+        thread timeshare one core, so "overlapped" host work is merely
+        starved work; there the pipeline resolves before issuing."""
+        c = self._cores
+        if c is None:
+            c = self._cores = os.cpu_count() or 1
+        return c > 1
+
+    def _ring_admit(self) -> None:
+        """Make room on the completion ring before an issue. Eagerly
+        retires whatever the device already finished (non-blocking
+        probe), enforces the ``async_depth`` in-flight bound, and -- on
+        serial-issue backends -- device-syncs the head: the proof of
+        completion must precede the next issue. With a spare host core
+        the head stays UNresolved so its resolution work overlaps the
+        next issue (see :meth:`_ring_settle`); on a single-core host it
+        resolves right here (see :meth:`_spare_core`).
+        Batcher-thread-only; runs outside the dispatch watchdog, each
+        blocking sync bounded by its own ``engine.retire`` deadline."""
+        while self._ring and self._ring_head_ready():
+            self._retire_oldest()
+        while len(self._ring) >= self.async_depth:
+            self._retire_oldest()
+        if self._issue_serial():
+            # device still busy (the eager loop above would have caught
+            # an idle one): wait for it bounded. With a spare core the
+            # entry is synced but NOT resolved -- resolution after the
+            # next issue keeps the device fed, the host work runs on
+            # another core. On a single-core host that deferral inverts:
+            # the settling thread is starved by the very execution it
+            # "overlaps" (measured: future resolution drifting ~0.5s into
+            # a 2.3s batch at 20q), so resolve-before-issue -- the
+            # latency-optimal order when host and device share the core.
+            defer_resolve = self._spare_core()
+            while self._ring and self._ring[0][4] is None:
+                self._retire_oldest(sync_only=defer_resolve)
+
+    def _ring_settle(self) -> None:
+        """Resolve ring entries whose device work admission already
+        proved complete -- called right AFTER an issue, so lane
+        extraction, the sentinel gate and future resolution run while
+        the just-issued batch executes."""
+        while self._ring and self._ring[0][4] is not None:
+            self._retire_oldest()
+
+    def _drop_entry(self, entry) -> None:
+        """Remove a failed entry from the ring if it is still the head
+        (resolve-stage failures already popped it)."""
+        if self._ring and self._ring[0] is entry:
+            self._ring.popleft()
+            telemetry.set_gauge("engine_async_inflight", len(self._ring))
+
+    def _retire_oldest(self, *, sync_only: bool = False) -> bool:
+        """Retire the OLDEST completion-ring entry: device-sync its
+        in-flight batch and resolve its futures, lane by lane, through
+        the same corrupt/sentinel/trace gates as a synchronous dispatch.
+        ``sync_only=True`` is the serial-issue admission step: it
+        device-syncs the head IN PLACE (same bounded wait, failures
+        attributed identically) but leaves it on the ring unresolved,
+        for a post-issue :meth:`_ring_settle`. Never raises -- every
+        failure mode resolves the ENTRY's futures typed (hang ->
+        quarantine, sentinel breach -> degrade/quarantine, anything
+        else -> the synchronous bisection ladder re-dispatches), so a
+        retire-time fault is attributed to the batch that actually
+        failed, never to whatever the host happens to be issuing (the
+        no-cross-batch-misattribution contract the chaos
+        ``async_dispatch_fault`` scenario proves). Returns False when the
+        ring is empty. Batcher-thread-only, like the ring itself."""
+        if not self._ring:
+            return False
+        import jax
+
+        from ..resilience import guard as _guard
+        entry = self._ring[0]
+        out, batch, tick, dev_t0, t_ready = entry
+        traced = [r.trace for r in batch if r.trace is not None]
+        if traced:
+            telemetry.set_current_trace(traced)
+        # the sync is a blocking boundary exactly like the dispatch is
+        _sync.guard_blocking("engine.retire")
+        outcome = "ok"
+        retired = True
+        try:
+            with telemetry.span("engine.retire", batch=len(batch),
+                                inflight=len(self._ring) - 1,
+                                stage="resolve" if t_ready else "sync"):
+                if t_ready is None:
+                    _guard.device_sync(lambda: jax.block_until_ready(out))
+                    t_ready = entry[4] = time.perf_counter()
+                    for req in batch:
+                        if req.trace is not None and dev_t0:
+                            req.trace.phase("device", dev_t0,
+                                            t_ready - dev_t0)
+                if sync_only:
+                    # proven complete, left on the ring: the entry's
+                    # resolution is deferred past the next issue
+                    retired = False
+                    return True
+                self._ring.popleft()
+                telemetry.set_gauge("engine_async_inflight", len(self._ring))
+                for i, req in enumerate(batch):
+                    lane = self._maybe_corrupt(out[i])
+                    self._sentinel_gate(lane, tick=tick)
+                    if req.trace is not None:
+                        self._trace_done(req, t_ready, time.perf_counter())
+                    _sync.resolve_future(req.fut, result=lane,
+                                         site="engine.retire")
+        except QuESTHangError as e:
+            # the device wedged AFTER issue: same quarantine as a
+            # synchronous hang, charged to this entry's requests
+            outcome = "hang"
+            self._drop_entry(entry)
+            self._note_breach(hang=True)
+            self._fail_batch(batch, e, site="engine.retire")
+        except QuESTIntegrityError as e:
+            outcome = "integrity"
+            self._drop_entry(entry)
+            self._note_breach(hang=False)
+            self._fail_batch(batch, e, site="engine.retire")
+        except Exception:
+            # a device-side error surfacing at the sync: re-dispatch the
+            # entry's unresolved requests through the SYNCHRONOUS
+            # bisection ladder (defer=False), so healthy lanes complete
+            # bit-identically and poisoned ones fail typed
+            outcome = "error"
+            self._drop_entry(entry)
+            pending = [r for r in batch if not r.fut.done()]
+            if pending:
+                self._bisect(pending, "vmap")
+        except BaseException as e:  # teardown must not hang waiters
+            outcome = "error"
+            self._drop_entry(entry)
+            self._fail_batch(batch, e, site="engine.retire")
+        else:
+            if retired:
+                self._note_clean()
+        finally:
+            if retired:
+                telemetry.inc("engine_async_retires_total", outcome=outcome)
+            if traced:
+                telemetry.clear_current_trace()
+        if retired:
+            now = time.perf_counter()
+            for req in batch:
+                telemetry.observe("engine_request_latency_seconds",
+                                  now - req.t0)
+        return True

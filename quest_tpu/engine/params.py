@@ -271,10 +271,18 @@ def bind(lifted: LiftedTape, params=None, device: bool = True) -> tuple:
 
     ``params`` maps Param names to numbers (missing names raise); anonymous
     slots replay their recorded defaults. With ``device=True`` (the
-    executable hot path) scalars are coerced to device arrays at the
+    executable hot path) scalars are coerced to NUMPY 0-d arrays at the
     process float/complex width (f64/c128 under jax x64, else f32/c64) so
-    the jit signature is stable across calls; ``device=False`` returns
-    plain host scalars (a tape materialized with them replays through the
+    the jit signature is stable across calls. Numpy, not jnp, on purpose:
+    ``jnp.asarray(v, dtype=...)`` enqueues a convert_element_type
+    COMPUTATION per scalar, and the PJRT CPU client bounds in-flight
+    computations -- a slot-rich circuit binding behind an in-flight batch
+    would block the SUBMITTER for a full device execution (the async
+    dispatch pipeline then starves at one arrival per batch). A numpy
+    scalar enters the program as a plain transfer at call time, has the
+    identical abstract value (no retrace), and binds in microseconds no
+    matter what the device is running. ``device=False`` returns plain
+    Python scalars (a tape materialized with them replays through the
     constant/numpy assembly path -- the bit-identity baseline the tests
     compare against)."""
     import jax.numpy as jnp
@@ -298,14 +306,14 @@ def bind(lifted: LiftedTape, params=None, device: bool = True) -> tuple:
         else:
             v = s.default
         if s.kind == _SEED:
-            # seeds are integer PRNG material: uint32 on device (a stable
-            # jit signature the vmap batcher can stack per lane), a plain
-            # int on the host/constant path. int() first so the engine's
-            # warmup binding (0.0 for every name) coerces cleanly.
-            out.append(jnp.asarray(int(v), dtype=jnp.uint32) if device
+            # seeds are integer PRNG material: uint32 on the hot path (a
+            # stable jit signature the vmap batcher can stack per lane), a
+            # plain int on the host/constant path. int() first so the
+            # engine's warmup binding (0.0 for every name) coerces cleanly.
+            out.append(np.asarray(int(v), dtype=np.uint32) if device
                        else int(v))
         elif device:
-            out.append(jnp.asarray(v, dtype=cdt if s.kind == _CPLX else rdt))
+            out.append(np.asarray(v, dtype=cdt if s.kind == _CPLX else rdt))
         else:
             out.append(complex(v) if s.kind == _CPLX else float(v))
     return tuple(out)

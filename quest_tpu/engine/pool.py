@@ -40,6 +40,15 @@ Robustness behaviors (ISSUE 13):
   ``admission_*_total`` counters). Admitted requests that momentarily
   have NO routable replica (e.g. mid-failover) park in priority-ordered
   pending queues (high drains first) instead of being rejected.
+- **Ahead-of-demand compilation** (round 18): the pool counts requests
+  per structure fingerprint; :meth:`EnginePool.precompile` ranks the
+  manifest by that frequency and warms the most popular executables OFF
+  the request path (``engine_precompile_total{outcome=warmed|cached|
+  error}`` -- the already-warm probe is a non-mutating LRU ``peek``, so
+  ranking never perturbs eviction order). ``precompile_ms`` > 0 runs it
+  periodically on a background ``quest-pool-precompile`` thread -- the
+  JAX persistent-compilation-cache discipline (PAPERS.md) applied to the
+  in-memory plan cache: never compile on the request path.
 - **Hedged dispatch** (``hedge_ms`` > 0): a request outstanding on a
   ``degraded`` replica past the hedge deadline is re-issued to a healthy
   peer through :func:`~quest_tpu.resilience.retry.call_with_retry`
@@ -214,7 +223,8 @@ class EnginePool:
                  queue_max: int | None = None, hedge_ms: float | None = None,
                  tenant_qps: int | None = None, admission=None,
                  precision_code: int | None = None, donate: bool = True,
-                 spawn_replacements: bool = True):
+                 spawn_replacements: bool = True,
+                 precompile_ms: float = 0.0):
         if replicas is None:
             replicas = _env_replicas()
         if replicas < 1:
@@ -223,6 +233,9 @@ class EnginePool:
             hedge_ms = _env_hedge_ms()
         if hedge_ms < 0:
             raise ValueError(f"hedge_ms must be >= 0, got {hedge_ms}")
+        if precompile_ms < 0:
+            raise ValueError(
+                f"precompile_ms must be >= 0, got {precompile_ms}")
         self._env = env
         self._engine_kw = dict(max_batch=max_batch,
                                max_delay_ms=max_delay_ms,
@@ -235,6 +248,7 @@ class EnginePool:
         self._cv = _sync.Condition("pool.cv")
         self._replicas: list[_Replica] = []
         self._manifest: dict = {}         # fingerprint -> circuit
+        self._freq: dict = {}             # fingerprint -> request count
         self._pending = {p: deque() for p in PRIORITIES}
         self._next_rid = 0
         self._closed = False
@@ -252,8 +266,16 @@ class EnginePool:
                 target=self._hedge_loop, name="quest-pool-hedge",
                 daemon=True)
             self._hedge_thread.start()
+        self.precompile_s = float(precompile_ms) / 1e3
+        self._precompile_thread = None
+        if self.precompile_s > 0:
+            self._precompile_thread = threading.Thread(
+                target=self._precompile_loop, name="quest-pool-precompile",
+                daemon=True)
+            self._precompile_thread.start()
         telemetry.event("pool.start", replicas=int(replicas),
-                        hedge_ms=float(hedge_ms))
+                        hedge_ms=float(hedge_ms),
+                        precompile_ms=float(precompile_ms))
 
     # -- submission ---------------------------------------------------------
 
@@ -309,6 +331,9 @@ class EnginePool:
         fp = circuit.fingerprint()
         with self._cv:
             self._manifest.setdefault(fp, circuit)
+            # per-structure frequency telemetry: the precompiler's ranking
+            # signal (round 18)
+            self._freq[fp] = self._freq.get(fp, 0) + len(params_list)
         deadline = None if timeout is None else time.monotonic() + timeout
         futs = []
         for params in params_list:
@@ -761,6 +786,99 @@ class EnginePool:
         with self._cv:
             return dict(self._manifest)
 
+    @property
+    def frequencies(self) -> dict:
+        """Fingerprint -> request count: the manifest frequency telemetry
+        the ahead-of-demand precompiler ranks by."""
+        with self._cv:
+            return dict(self._freq)
+
+    # -- ahead-of-demand compilation (round 18) ------------------------------
+
+    def precompile(self, limit: int | None = None, replica=None) -> list:
+        """Warm the plan cache OFF the request path: rank every structure
+        fingerprint this pool has served by request frequency (descending,
+        fingerprint-lexicographic tiebreak) and ensure the hottest
+        ``limit`` of them (None = all) hold warm executables on
+        ``replica`` (an id, or None = every in-rotation replica).
+
+        Per (fingerprint, replica) outcome, counted
+        ``engine_precompile_total{outcome}``:
+
+        - ``cached`` -- the replica's engine exists and the process-global
+          LRU still holds its batch executable (probed with the
+          NON-MUTATING :meth:`~quest_tpu.engine.cache.LRUCache.peek`, so
+          ranking never promotes a precompiled entry over one live
+          traffic is using);
+        - ``warmed`` -- a cold engine was built (or an evicted executable
+          re-warmed) via :meth:`Engine.warmup`;
+        - ``error`` -- the warm attempt failed; request traffic is
+          unaffected (the hot path compiles lazily as before).
+
+        Returns the fingerprints warm on every targeted replica, in rank
+        order."""
+        from . import cache as _ec
+        with self._cv:
+            ranked = sorted(self._freq,
+                            key=lambda fp: (-self._freq[fp], fp))
+            manifest = {fp: self._manifest[fp] for fp in ranked
+                        if fp in self._manifest}
+            if replica is None:
+                reps = [r for r in self._replicas if r.in_rotation]
+            else:
+                reps = [r for r in self._replicas if r.id == replica]
+                if not reps:
+                    raise ValueError(f"no replica with id {replica!r}")
+        if limit is not None:
+            manifest = dict(list(manifest.items())[:max(0, limit)])
+        done = []
+        for fp, circ in manifest.items():
+            ok = True
+            for rep in reps:
+                with self._cv:
+                    eng = rep.engines.get(fp)
+                try:
+                    if eng is not None and eng._open:
+                        key = ("param_vmap", eng.fingerprint,
+                               eng.max_batch, eng.dtype.str, eng._donate)
+                        if eng._mode() != "vmap" or \
+                                _ec.executables().peek(key) is not None:
+                            telemetry.inc("engine_precompile_total",
+                                          outcome="cached")
+                            continue
+                        eng.warmup()
+                    else:
+                        self._engine_for(rep, fp, circ).warmup()
+                    telemetry.inc("engine_precompile_total",
+                                  outcome="warmed")
+                except Exception as e:
+                    ok = False
+                    telemetry.inc("engine_precompile_total",
+                                  outcome="error")
+                    telemetry.event("pool.precompile_failed",
+                                    fingerprint=fp[:12],
+                                    error=type(e).__name__)
+            if ok:
+                done.append(fp)
+        if done:
+            telemetry.event("pool.precompile", warmed=len(done),
+                            replicas=len(reps))
+        return done
+
+    def _precompile_loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                self._cv.wait(self.precompile_s)
+                if self._closed:
+                    return
+            try:
+                self.precompile()
+            except Exception as e:  # pragma: no cover - warm best-effort
+                telemetry.event("pool.precompile_failed",
+                                fingerprint="", error=type(e).__name__)
+
     # -- hedging ------------------------------------------------------------
 
     def _hedge_loop(self) -> None:
@@ -923,6 +1041,9 @@ class EnginePool:
                     pass
         if self._hedge_thread is not None and self._hedge_thread.is_alive():
             _sync.join_thread(self._hedge_thread)
+        if self._precompile_thread is not None \
+                and self._precompile_thread.is_alive():
+            _sync.join_thread(self._precompile_thread)
         telemetry.set_gauge("pool_replicas", 0)
         telemetry.event("pool.close", drained=drain)
 

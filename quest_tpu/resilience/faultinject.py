@@ -88,7 +88,8 @@ SITES: dict[str, tuple[str, ...]] = {
     "pallas.dispatch": ("transient", "compile"),
     "exchange.collective": ("transient", "hang"),
     "engine.request": ("poison",),
-    "engine.dispatch": ("hang",),
+    "engine.dispatch": ("hang", "transient"),
+    "engine.retire": ("hang",),
     "pool.replica": ("kill", "hang"),
     "checkpoint.write": ("torn", "corrupt", "io"),
     "segment.boundary": ("preempt",),

@@ -43,8 +43,9 @@ from .errors import (KernelCompileFault, QuESTIntegrityError,
 if TYPE_CHECKING:
     import jax
 
-__all__ = ["DEGRADED", "pallas_dispatch", "collective", "checkpoint_write",
-           "segment_boundary", "corrupt_amps", "sentinel_replay"]
+__all__ = ["DEGRADED", "pallas_dispatch", "collective", "device_sync",
+           "checkpoint_write", "segment_boundary", "corrupt_amps",
+           "sentinel_replay"]
 
 T = TypeVar("T")
 
@@ -113,6 +114,22 @@ def collective(fn: Callable[[], T], *, site: str = "exchange.collective",
         raise QuESTRetryError(
             f"collective at {site!r} still failing after retry budget "
             f"({e})", site) from e
+
+
+def device_sync(fn: Callable[[], T], *, site: str = "engine.retire") -> T:
+    """Run a completion-side device sync (the async dispatch pipeline's
+    ring retire: ``jax.block_until_ready`` on an in-flight batch). With
+    ``QUEST_WATCHDOG_MS`` armed the sync is deadline-bounded -- a wedged
+    device surfaces as a typed :class:`QuESTHangError` attributed to the
+    RING ENTRY being retired, never to the batch the host happens to be
+    issuing. No retry: a device error at retire is the caller's bisection
+    ladder's problem (the result buffers are gone either way). Injected
+    ``hang`` faults at ``site`` stall past the watchdog deadline so the
+    retire-time hang path is provable."""
+    if not faultinject.enabled():
+        return watchdog.watched(fn, site=site)
+    kind = faultinject.fire(site)
+    return watchdog.watched(fn, site=site, hang=(kind == "hang"))
 
 
 def checkpoint_write(write: Callable[[], str],

@@ -43,8 +43,11 @@ Every device program launch counts ``device_dispatch_total{route}``
 host-side (telemetry counters inside jit would count traces, not
 executions): ``route="segment"`` per segment program, ``route="item"``
 per eagerly interpreted tape entry, ``route="circuit"`` per whole-tape
-``Circuit.run`` dispatch, ``route="engine_vmap"`` / ``"engine_param"``
-at the serving engine's two dispatch sites. docs/observability.md has
+``Circuit.run`` dispatch, ``route="request"`` per whole-request program
+(:func:`request_executable` -- round 18: every segment plus the final
+reduction composed into ONE dispatched program, the
+``dispatches_per_circuit == 1`` floor), ``route="engine_vmap"`` /
+``"engine_param"`` at the serving engine's two dispatch sites. docs/observability.md has
 the full table; ``bench.py --config dispatch`` measures the A/B.
 
 ``QUEST_SEGMENT_DISPATCH`` (default 1 = on; 0 restores item-by-item
@@ -64,6 +67,7 @@ __all__ = [
     "identity_boundaries", "segment_cuts", "stamp_plan",
     "segment_dispatch_default", "segment_dispatch_enabled", "force_route",
     "slice_executable", "run_slice", "chain_executable",
+    "request_executable",
 ]
 
 _SEG_ENV = "QUEST_SEGMENT_DISPATCH"
@@ -336,5 +340,70 @@ def chain_executable(circuit, max_items: int | None = None,
 
         chained.num_segments = len(fns)
         return chained
+
+    return _ec.executables().get_or_create(key, build)
+
+
+def request_executable(circuit, donate: bool = True, reduce=None):
+    """The WHOLE request as ONE dispatched program (round 18): every
+    frame-identity segment of the tape, plus an optional final traceable
+    ``reduce(amps)`` (a probability readout, an expectation contraction),
+    composed inside a single ``jax.jit`` with the state buffer donated
+    end-to-end -- intermediate segment states live and die inside the
+    one XLA program, never round-tripping through the host. A request
+    then touches the host exactly twice (submit, result) and
+    ``device_dispatch_total{route="request"}`` counts exactly ONE launch
+    per call: ``dispatches_per_circuit`` hits its floor of 1, where
+    :func:`chain_executable` pays one launch per segment.
+
+    The segment seams (every :func:`identity_boundaries` return to frame
+    identity) are preserved as replay-slice boundaries, so the program
+    is the composition of the SAME per-segment replays the chained and
+    checkpointed routes run -- slice replays compose into the identical
+    primitive sequence as the whole-tape replay, making the request
+    program bit-identical to ``compiled()`` run-to-run (the chained-vs-
+    item cross-granularity caveat in the module docstring still applies
+    on XLA-CPU). Cached in the process-global LRU under
+    ``("request_chain", ...)``; ``fn.num_segments`` reports how many
+    segments were composed, ``fn.num_dispatches = 1`` the launch
+    count."""
+    import jax
+
+    from . import fusion
+    from .engine import cache as _ec
+    from .parallel import scheduler as _dist
+    sched = _dist.active()
+    mesh = sched.mesh if sched else None
+    pmesh = fusion.active_pallas_mesh()
+    key = ("request_chain", circuit._cache_token, donate, reduce, mesh,
+           pmesh)
+
+    def build():
+        nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
+        bounds = identity_boundaries(circuit._tape, nsv)
+        if bounds[-1] != len(circuit._tape):
+            bounds.append(len(circuit._tape))
+        replays = tuple(circuit._replay_fn(None, lo=a, hi=b)
+                        for a, b in zip(bounds, bounds[1:]))
+
+        def whole(amps, _replays=replays, _reduce=reduce):
+            for f in _replays:
+                amps = f(amps)
+            return amps if _reduce is None else _reduce(amps)
+
+        inner = jax.jit(whole, donate_argnums=(0,) if donate else ())
+
+        def fn(amps, _inner=inner, _mesh=mesh, _pmesh=pmesh):
+            from .circuits import _amps_mesh
+            pm = _pmesh if _pmesh is not None else _amps_mesh(amps)
+            # ONE launch for the whole request -- the counter delta the
+            # bench's dispatches_per_circuit row and native.yml gate read
+            telemetry.inc("device_dispatch_total", route="request")
+            with _dist.explicit_mesh(_mesh), fusion.pallas_mesh(pm):
+                return _inner(amps)
+
+        fn.num_segments = len(replays)
+        fn.num_dispatches = 1
+        return fn
 
     return _ec.executables().get_or_create(key, build)

@@ -36,6 +36,16 @@ of them report into and every artifact is derived from:
   warn once as QT701); errored requests are always captured; the off
   path is one boolean read (:func:`trace_on`), same contract as
   :func:`span`.
+- **Async serving series** (round 18): the completion-ring engine
+  reports ``engine_async_inflight`` (gauge: ring occupancy after every
+  admit / retire) and
+  ``engine_async_retires_total{outcome=ok|hang|integrity|error}`` (one
+  per retired in-flight batch, through the same corrupt / sentinel /
+  trace gates as a synchronous dispatch); the pool's ahead-of-demand
+  compiler counts ``engine_precompile_total{outcome=warmed|cached|
+  error}``; whole-request chaining launches exactly one program per
+  request -- ``device_dispatch_total{route="request"}``, the round-18
+  dispatch floor (docs/serving.md).
 
 Semantics notes:
 

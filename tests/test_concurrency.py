@@ -10,12 +10,13 @@ Contracts under test:
 - QT601 detects a constructed two-lock ordering cycle (with the
   first-occurrence stacks attached) and reports NOTHING over the graph
   the real serving workload records;
-- the interleaving explorer schedule-completes all three production
+- the interleaving explorer schedule-completes all four production
   race scenarios (submit-vs-close, quarantine-failover, hedged
-  dispatch) with zero breaches on clean code, exploring more than one
-  distinct interleaving each -- and every seeded mutation (dropped
-  lock, resolution moved inside the lock, stripped once-resolution
-  guard, skipped drain hand-off) is caught;
+  dispatch, async-dispatch-vs-drain) with zero breaches on clean code,
+  exploring more than one distinct interleaving each -- and every
+  seeded mutation (dropped lock, resolution moved inside the lock,
+  stripped once-resolution guard, skipped drain hand-off, forgotten
+  completion-ring drain) is caught;
 - the QT603 atomicity and QT604 raw-lock AST lints flag the seeded
   fixtures, honor the allow pragma and the locked-helper call-graph
   fixpoint, and report nothing over the shipped package.
@@ -324,6 +325,27 @@ def test_mutation_skipped_drain_handoff_detected(scenarios, monkeypatch):
     assert r.breaches
     assert any("never resolved" in b or "deadlock" in b or "lost" in b
                for b in r.breaches)
+
+
+def test_mutation_forgotten_ring_drain_detected(scenarios, monkeypatch):
+    """Mutation 5 (round 18): ``_retire_oldest`` pops the completion-ring
+    head WITHOUT resolving its futures -- the async-pipeline analogue of
+    the skipped drain hand-off. Some schedule admits a batch to the ring
+    before close drains, and the stranded client surfaces as a deadlock
+    or no-outcome breach."""
+
+    def leaky_retire(self, *, sync_only=False):
+        if not self._ring:
+            return False
+        self._ring.popleft()  # MUTATION: entry dropped, futures stranded
+        return True
+
+    monkeypatch.setattr(Engine, "_retire_oldest", leaky_retire)
+    r = C.InterleavingExplorer(max_schedules=24).explore(
+        scenarios["async_dispatch_drain"])
+    assert r.breaches
+    assert any("deadlock" in b or "recorded no outcome" in b
+               or "never resolved" in b for b in r.breaches)
 
 
 def test_closed_engine_dispatch_fails_over(scenarios):

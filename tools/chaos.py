@@ -175,6 +175,96 @@ def engine_poison_bisection(env, env8):
 
 
 @scenario
+def async_dispatch_fault(env, env8):
+    """Round 18: dispatch faults under the ASYNC completion ring stay
+    attributed to the batch that actually failed -- no cross-batch
+    misattribution. Three legs over a warm depth-2 engine streaming 8
+    requests (two pipelined batches of 4): (a) an issue-time transient on
+    batch 2 bisects and recovers THAT batch while batch 1, already in
+    flight on the ring, resolves untouched and bit-identical; (b) an
+    injected dispatch hang fails ONLY its own batch typed
+    (QuESTHangError) -- the other batch's futures still serve; (c) a
+    retire-time hang on the ring head fails the RETIRED batch, and the
+    entry behind it on the ring still resolves bit-identically."""
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.circuits import Circuit
+    from quest_tpu.resilience import fault_plan, watchdog_deadline
+    from quest_tpu.resilience.errors import QuESTHangError
+
+    c = Circuit(3)
+    c.hadamard(0)
+    c.controlledNot(0, 1)
+    c.rotateX(2, qt.P("t"))
+    plist = [{"t": 0.1 * i} for i in range(8)]
+    exe = c.parameterized(donate=False)
+    oracle = []
+    for p in plist:
+        q = qt.createQureg(3, env)
+        oracle.append(np.asarray(exe(q.amps, p)))
+
+    # (a) issue-time transient on the second pipelined batch
+    telemetry.reset()
+    eng = qt.Engine(c, env, max_batch=4, max_delay_ms=0.0, async_depth=2)
+    eng.run(plist[0])  # warm: the faulted stream is pure replay
+    with fault_plan("engine.dispatch:transient:2"):
+        futs = eng.submit_many(plist)  # batch 1 rides the ring; batch 2
+        got = [np.asarray(f.result(timeout=120)) for f in futs]  # faults
+    eng.close()
+    for i, (w, g) in enumerate(zip(oracle, got)):
+        assert np.array_equal(w, g), f"lane {i} diverged under transient"
+    bisections = int(telemetry.counter_value("engine_bisections_total"))
+    assert bisections >= 1, "transient batch never bisected"
+    ok_retires = int(telemetry.counter_value("engine_async_retires_total",
+                                             outcome="ok"))
+    assert ok_retires >= 1, "the in-flight batch never retired cleanly"
+
+    # (b) dispatch hang: only the hung batch fails, and it fails typed
+    telemetry.reset()
+    eng2 = qt.Engine(c, env, max_batch=4, max_delay_ms=0.0, async_depth=2)
+    eng2.run(plist[0])
+    with watchdog_deadline(200), fault_plan("engine.dispatch:hang:2"):
+        futs = eng2.submit_many(plist)
+        served, hung = {}, []
+        for i, f in enumerate(futs):
+            try:
+                served[i] = np.asarray(f.result(timeout=120))
+            except QuESTHangError:
+                hung.append(i)
+    eng2.close()
+    assert len(hung) == 4, f"expected one hung batch of 4, got {hung}"
+    assert len(served) == 4, "the healthy batch must still serve"
+    for i, g in served.items():
+        assert np.array_equal(oracle[i], g), \
+            f"lane {i} diverged next to the hung batch"
+
+    # (c) retire-time hang: the RETIRED entry fails; the entry queued
+    # behind it on the ring still resolves bit-identically
+    telemetry.reset()
+    eng3 = qt.Engine(c, env, max_batch=4, max_delay_ms=0.0, async_depth=2)
+    eng3.run(plist[0])
+    with watchdog_deadline(200), fault_plan("engine.retire:hang:1"):
+        futs = eng3.submit_many(plist)
+        served, hung = {}, []
+        for i, f in enumerate(futs):
+            try:
+                served[i] = np.asarray(f.result(timeout=120))
+            except QuESTHangError:
+                hung.append(i)
+    eng3.close()
+    assert len(hung) == 4, f"expected one hung retire of 4, got {hung}"
+    for i, g in served.items():
+        assert np.array_equal(oracle[i], g), \
+            f"lane {i} diverged behind the hung retire"
+    hang_retires = int(telemetry.counter_value("engine_async_retires_total",
+                                               outcome="hang"))
+    assert hang_retires == 1, "retire hang not counted once"
+    return {"transient_bitident": True, "bisections": bisections,
+            "dispatch_hang_isolated": True, "retire_hang_isolated": True,
+            "checksum": _checksum(got[0])}
+
+
+@scenario
 def checkpoint_corrupt_resume_fallback(env, env8):
     """A bit-rotted newest checkpoint generation is rejected (QT305) and
     resume falls back to the previous verified one, finishing
