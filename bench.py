@@ -1810,6 +1810,108 @@ def bench_dispatch(n: int, depth: int, reps: int) -> dict:
     }
 
 
+def bench_sample(n: int, depth: int, shots: int, reps: int) -> dict:
+    """CI-gate config ``sample_20q`` (round 19): on-device batched
+    sampling. Headline is shots/sec through the batch-8 trajectory route
+    (8 vmap lanes, each ending in the on-device S-shot sampler via the
+    Engine ``finalize`` hook -- T*S int32 words cross to the host, never
+    T*2^n amplitudes). The gate evidence rides in the detail: the
+    one-dispatch request leg (circuit + S shots as ONE
+    ``device_dispatch_total{route=request}`` launch,
+    ``dispatches_per_request == 1``), its sampled marginal over a
+    6-qubit target subset against the exact ``calcProbOfAllOutcomes``
+    oracle (``marginals_match_oracle``), and fixed-seed replay
+    bit-identity of the shot table (``seed_replay_bitident``)."""
+    import time
+
+    import jax
+
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.engine import P
+    from quest_tpu.ops import init as ops_init
+    from quest_tpu.precision import real_dtype
+    from quest_tpu.sampling import request as rq
+
+    batch = 8
+    metric = (f"shots/sec, {n}q circuit + on-device batched sampling "
+              f"(batch-{batch} vmap lanes, S={shots} shots each)")
+    env = qt.createQuESTEnv(jax.devices()[:1])
+    dtype = np.dtype(real_dtype())
+
+    # --- the one-dispatch request leg: correctness evidence ----------
+    circ = build_circuit(n, depth)
+    targets = tuple(range(6))           # 64-outcome marginal vs oracle
+    s_req = max(int(shots), 4096)
+    exe = rq.sample_request(circ, targets=targets, shots=s_req,
+                            donate=False)
+
+    def fresh():
+        return ops_init.init_classical(1 << n, dtype, 0)
+
+    r0 = telemetry.counter_value("device_dispatch_total", route="request")
+    out = rq.to_host(exe(fresh(), 7))
+    dispatches = int(telemetry.counter_value(
+        "device_dispatch_total", route="request") - r0)
+    table = out["shots"]
+    transfer = int(telemetry.snapshot()["gauges"]
+                   ["sample_host_transfer_bytes"])
+    replay = rq.to_host(exe(fresh(), 7))["shots"]
+    seed_replay_bitident = bool(np.array_equal(table, replay))
+
+    # exact oracle: evolve the same circuit, read the 64 marginal
+    # probabilities, compare against the empirical shot frequencies
+    q = qt.createQureg(n, env)
+    q.put(circ.fused(max_qubits=5, pallas=True).compiled_segments()(q.amps))
+    oracle = np.asarray(qt.calcProbOfAllOutcomes(q, targets),
+                        dtype=np.float64)
+    freq = np.bincount(table, minlength=1 << len(targets)) / float(s_req)
+    marginal_maxdiff = float(np.max(np.abs(freq - oracle)))
+    tol = 4.0 / float(np.sqrt(s_req))
+    del q, out, table, replay
+
+    # --- the batch-8 throughput leg ----------------------------------
+    # one mid-circuit measurement makes the tape carry the one named
+    # seed Param the trajectory route binds per lane; the terminal
+    # sampler composes in as the Engine finalize stage
+    ens = build_circuit(n, depth)
+    ens.applyMidMeasurement(0, P("m"), site=7)
+    res = qt.run_ensemble(ens, batch, shots=int(shots), shot_seed=11)
+    assert res.shot_tables.shape == (batch, int(shots))
+    best = float("inf")
+    for _ in range(max(min(reps, 3), 1)):
+        t0 = time.perf_counter()
+        res = qt.run_ensemble(ens, batch, shots=int(shots), shot_seed=11)
+        best = min(best, time.perf_counter() - t0)
+    total_shots = batch * int(shots)
+    rate = total_shots / best
+
+    return {
+        "config": "sample_20q",
+        "metric": metric,
+        "value": round(rate, 1),
+        "unit": "shots/sec",
+        "vs_baseline": None,
+        "detail": {
+            "qubits": n,
+            "depth": depth,
+            "batch": batch,
+            "shots_per_lane": int(shots),
+            "total_shots": total_shots,
+            "shots_per_sec": round(rate, 1),
+            "ensemble_ms": round(best * 1e3, 2),
+            "request_shots": s_req,
+            "dispatches_per_request": dispatches,
+            "marginals_match_oracle": bool(marginal_maxdiff <= tol),
+            "marginal_maxdiff": marginal_maxdiff,
+            "marginal_tol": tol,
+            "seed_replay_bitident": seed_replay_bitident,
+            "host_transfer_bytes": transfer,
+            "transfer_is_o_s": bool(transfer == s_req * 4),
+        },
+    }
+
+
 def _trajectories_config(reps: int, smoke: bool) -> dict:
     """Run the trajectories_20q row, re-execing into an 8-virtual-device
     subprocess when this process's backend has a single device, so the
@@ -1927,7 +2029,7 @@ def main() -> None:
                             "f64", "plan_f64", "plan_34q_f64",
                             "20q", "24q", "26q", "serve", "resilience",
                             "sentinel", "comm", "trajectories",
-                            "dispatch", "pool"],
+                            "dispatch", "pool", "sample"],
                    default="all",
                    help="all: every BASELINE.json milestone config (default);"
                         " statevec: one random Clifford+T run at --qubits;"
@@ -1968,7 +2070,12 @@ def main() -> None:
                         " req/sec + p50/p99, one injected replica kill"
                         " mid-run with zero lost futures + failover"
                         " bit-identity + warmed-replacement zero-retrace"
-                        " asserted)")
+                        " asserted);"
+                        " sample: the sample_20q row (on-device batched"
+                        " sampling: shots/sec at batch-8 via the Engine"
+                        " finalize hook, one-dispatch request leg with"
+                        " sampled-marginals-vs-oracle + fixed-seed"
+                        " shot-table replay bit-identity asserted)")
     p.add_argument("--emit", choices=["headline", "full"],
                    default="headline",
                    help="headline: compact <=1KB final line + "
@@ -2097,6 +2204,11 @@ def main() -> None:
         r = bench_pool(20, 2 if args.smoke else 4, args.reps)
         _emit(r, [r], args.emit)
         return
+    if args.config == "sample":
+        r = bench_sample(20, 2 if args.smoke else 4,
+                         8192 if args.smoke else 65536, args.reps)
+        _emit(r, [r], args.emit)
+        return
     if args.config in ("20q", "24q", "26q"):
         r = bench_statevec(int(args.config[:-1]), args.depth, args.reps,
                            sync)
@@ -2153,6 +2265,11 @@ def main() -> None:
             # bit-identity, warmed-replacement zero-retrace (ISSUE 13
             # gate)
             cfgs.append(bench_pool(20, 2, 3))
+            # ... and the sample row: on-device batched sampling --
+            # circuit + S shots as ONE request dispatch, sampled
+            # marginals vs the exact oracle, fixed-seed shot-table
+            # replay bit-identity, batch-8 shots/sec (ISSUE 18 gate)
+            cfgs.append(bench_sample(20, 2, 8192, 3))
         _emit(r, cfgs, args.emit)
         return
 
@@ -2201,6 +2318,7 @@ def main() -> None:
     configs.append(_trajectories_config(args.reps, False))
     configs.append(bench_dispatch(20, 4, args.reps))
     configs.append(bench_pool(20, 4, args.reps))
+    configs.append(bench_sample(20, 4, 65536, args.reps))
     # headline = the 26q statevec config, selected by metric string so list
     # reordering can never silently change what is reported
     headline = dict(next(c for c in configs

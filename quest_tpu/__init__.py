@@ -71,5 +71,9 @@ from . import trajectories  # noqa: F401
 from .trajectories import (  # noqa: F401
     applyTrajectoryKraus, ensemble_density, run_ensemble, unravel,
 )
+from . import sampling  # noqa: F401
+from .sampling import (  # noqa: F401
+    applyMidCollapse, applyMidMeasurement, sampleQureg, sample_request,
+)
 
 __version__ = "0.1.0"

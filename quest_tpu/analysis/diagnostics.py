@@ -22,7 +22,9 @@ reports :class:`Finding` records drawn from one code catalog:
 - ``QT7xx`` -- request-tracing hygiene (malformed ``QUEST_TRACE``, spans
   left open at export, trace contexts leaked across pooled-thread reuse
   -- :mod:`quest_tpu.analysis.tracecheck` over
-  :mod:`quest_tpu.telemetry`, docs/observability.md).
+  :mod:`quest_tpu.telemetry`, docs/observability.md),
+- ``QT8xx`` -- sampling (``QUEST_SHOTS`` hygiene --
+  :mod:`quest_tpu.sampling`, docs/sampling.md).
 
 Each finding carries a severity (``error`` | ``warning`` | ``info``), a
 human-readable location and a one-line fix hint. :func:`emit_findings`
@@ -68,6 +70,12 @@ CATALOG: dict[str, tuple[str, str, str]] = {
     "QT004": ("error", "control/target overlap in a captured gate event",
               "use disjoint control and target qubits; this only fails "
               "at apply time"),
+    "QT005": ("error", "measurement site inside a deferred-relocation "
+                       "window",
+              "a mid-circuit measurement/collapse reduces the target's "
+              "marginal in RAW amplitude order, but the frame is not at "
+              "identity there: move the site to an identity boundary or "
+              "let the scheduler reconcile before it"),
     # -- QT1xx: plan verification -------------------------------------------
     "QT101": ("error", "dense kernel-op target outside the legal "
                        "physical tile",
@@ -280,6 +288,11 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "or more than 110% of the request's wall-clock: an "
               "instrumentation site is missing a phase attribution or "
               "double-counting one"),
+    # -- QT8xx: sampling (quest_tpu/sampling) -------------------------------
+    "QT801": ("warning", "malformed QUEST_SHOTS value ignored",
+              "set QUEST_SHOTS to an integer >= 1; the malformed value "
+              "warns once per process and the default shot count is "
+              "used"),
 }
 
 

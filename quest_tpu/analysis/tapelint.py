@@ -24,9 +24,14 @@ resolved. Four lints:
   time. Also exposed standalone as :func:`lint_events` for synthetic /
   kernel-level event streams.
 
-A fifth check rides the same walk: **QT502** flags trajectory channel
+Two more checks ride the same walk: **QT502** flags trajectory channel
 sites (``applyTrajectoryKraus`` entries, quest_tpu/trajectories) whose
-Kraus set is not CPTP -- a biased unraveling, caught at record time.
+Kraus set is not CPTP -- a biased unraveling, caught at record time --
+and **QT005** flags mid-circuit measurement/collapse sites
+(``quest_tpu.sampling.measure`` entries, tagged ``_measurement_site``)
+that sit inside a deferred-relocation window: their marginal reduction
+reads raw amplitude order, so the frame must be at identity there
+(:func:`..segments.identity_boundaries`).
 
 Entries the spy cannot capture (operator entries, Param-carrying
 entries, inits) act as lint barriers, exactly as they act as fusion
@@ -158,7 +163,7 @@ def _lint_traj_kraus(args, kwargs, where: str) -> list[Finding]:
 def lint_tape(tape, num_qubits: int, *, is_density: bool = False,
               dtype=None, location: str = "tape") -> list[Finding]:
     """Lint a recorded tape (list of ``(fn, args, kwargs)`` entries); see
-    the module docstring for the four lint classes."""
+    the module docstring for the lint classes."""
     from ..engine.params import _LIFTABLE, lift_slot_census
     from ..fusion import capture
     from ..precision import real_dtype
@@ -171,12 +176,30 @@ def lint_tape(tape, num_qubits: int, *, is_density: bool = False,
     live_events: list[tuple] = []   # (entry_idx, GateEvent)
     # entry-level window for QT002
     live_entries: list[tuple] = []  # (entry_idx, structure_key, support)
+    # identity-boundary set for QT005, computed lazily on the first
+    # measurement site (the walk is O(tape) either way)
+    id_bounds: set | None = None
 
     for idx, (fn, args, kwargs) in enumerate(tape):
         name = getattr(fn, "__name__", "")
         where = f"{location}[{idx}]:{name}"
         if name == "applyTrajectoryKraus":
             findings.extend(_lint_traj_kraus(args, kwargs, where))
+        # QT005: a mid-circuit measurement/collapse site reduces the
+        # target's marginal in RAW amplitude order -- inside a deferred-
+        # relocation window (frame not at identity) that marginal is over
+        # the WRONG qubit
+        if getattr(fn, "_measurement_site", False):
+            if id_bounds is None:
+                from ..segments import identity_boundaries
+                nsv = (2 if is_density else 1) * num_qubits
+                id_bounds = set(identity_boundaries(tape, nsv))
+            if idx not in id_bounds:
+                findings.append(make_finding(
+                    "QT005",
+                    f"measurement site '{name}' at entry [{idx}] is not "
+                    f"at a frame-identity boundary: its marginal would "
+                    f"be reduced under a deferred qubit layout", where))
         events = capture(fn, args, kwargs, num_qubits, dt,
                          is_density=is_density)
         if events is None:

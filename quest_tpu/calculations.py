@@ -188,21 +188,33 @@ def _expec_pauli_sum_fused(amps, coeffs, *, codes, n, density):
     return total
 
 
+def expec_pauli_sum_amps(amps, coeffs, *, codes, n, density):
+    """sum_t c_t <P_t> as a TRACEABLE function of the planar amps: the
+    body of the fused expectation, exposed (round 19) so the sampling
+    request path can lower calcExpecPauliSum into a request executable's
+    terminal ``reduce(amps)`` stage -- circuit + shots + expectation as
+    one dispatched program. ``codes`` is a static tuple of code tuples;
+    term unrolling happens at trace time exactly as under the jitted
+    eager entry."""
+    nsv = (2 if density else 1) * n
+    total = 0.0
+    for t, term in enumerate(codes):
+        work = _pauli_prod_amps(amps, term, nsv, amps.dtype)
+        if density:
+            val = R.total_prob_density(work, n=n)
+        else:
+            val = R.inner_product(amps, work)[0]
+        total = total + coeffs[t] * val
+    return total
+
+
 def _make_expec_pauli_sum_run():
     import jax
 
     @partial(jax.jit, static_argnames=("codes", "n", "density"))
     def run(amps, coeffs, *, codes, n, density):
-        nsv = (2 if density else 1) * n
-        total = 0.0
-        for t, term in enumerate(codes):
-            work = _pauli_prod_amps(amps, term, nsv, amps.dtype)
-            if density:
-                val = R.total_prob_density(work, n=n)
-            else:
-                val = R.inner_product(amps, work)[0]
-            total = total + coeffs[t] * val
-        return total
+        return expec_pauli_sum_amps(amps, coeffs, codes=codes, n=n,
+                                    density=density)
 
     return run
 

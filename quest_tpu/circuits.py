@@ -31,9 +31,11 @@ from . import telemetry
 from .registers import Qureg
 
 #: API names that can be recorded on a tape: mutate qureg.amps, need no host
-#: round-trip at run time. (measure/collapse and calc* are excluded.)
+#: round-trip at run time. (measure/collapse and calc* are excluded --
+#: their RECORDABLE forms live in sampling.measure, which draws/forces
+#: outcomes branch-free on device instead of host-syncing a probability.)
 _TAPEABLE_MODULES = ("gates", "operators", "decoherence", "state_init",
-                     "trajectories.noise")
+                     "trajectories.noise", "sampling.measure")
 _EXCLUDED = {
     "measure", "measureWithStats", "collapseToOutcome",
     # these need host data or aren't pure amps->amps
@@ -408,7 +410,7 @@ class Circuit:
                 self._tape, self.num_qubits, self.is_density_matrix))
         return self._fp_cache[1]
 
-    def parameterized(self, donate: bool = True):
+    def parameterized(self, donate: bool = True, reduce=None):
         """The tape as ONE jitted executable whose lifted values (Params and
         constant angles/Complex scalars) are runtime arguments: a
         :class:`~quest_tpu.engine.params.ParamExecutable` called as
@@ -416,6 +418,13 @@ class Circuit:
         gate matrices assemble from the traced scalars inside the program
         (matrices.py traced branches), including between the static kernel
         runs of a fused Pallas plan.
+
+        ``reduce`` (round 19): an optional traceable terminal stage
+        composed INSIDE the jitted program -- the executable returns
+        ``reduce(final_amps)`` (e.g. a shot table, an expectation)
+        instead of the amplitudes, so the 2^N state never crosses to the
+        host. Must be a stable (cached) callable: it is part of the
+        executable-cache key.
 
         Cached in the global LRU keyed by (structure fingerprint, mode
         meshes): two structure-equal circuits -- same ansatz, different
@@ -430,11 +439,15 @@ class Circuit:
         pmesh = fusion.active_pallas_mesh()
         lifted = self.lifted()
         fp = self.fingerprint()
-        key = ("param", fp, donate, mesh, pmesh)
+        key = ("param", fp, donate, mesh, pmesh, reduce)
 
         def build():
-            inner = jax.jit(self._replay_fn(lifted),
-                            donate_argnums=(0,) if donate else ())
+            body = self._replay_fn(lifted)
+            if reduce is not None:
+                whole = lambda amps, values: reduce(body(amps, values))  # noqa: E731
+            else:
+                whole = body
+            inner = jax.jit(whole, donate_argnums=(0,) if donate else ())
 
             def fn(amps, values, _inner=inner, _mesh=mesh, _pmesh=pmesh):
                 pm = _pmesh if _pmesh is not None else _amps_mesh(amps)

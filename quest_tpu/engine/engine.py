@@ -215,7 +215,8 @@ class Engine:
                  max_batch: int = 8, max_delay_ms: float = 2.0,
                  initial="zero", donate: bool = True,
                  queue_max: int | None = None,
-                 async_depth: int | None = None):
+                 async_depth: int | None = None,
+                 finalize=None):
         import jax
         import jax.numpy as jnp
 
@@ -243,6 +244,13 @@ class Engine:
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1e3
         self._donate = bool(donate)
+        # round 19: optional traceable terminal stage composed INSIDE the
+        # dispatched program (e.g. a sampling.sample_reduce shot table) --
+        # futures then resolve to finalize(final_amps), never the 2^N
+        # amplitudes, and the amps-shaped sentinel / corrupt-injection
+        # gates are bypassed (the result is not a state). Must be a
+        # stable (cached) callable: it keys the executable LRU.
+        self._finalize = finalize
         self.dtype = real_dtype(precision_code)
         nsv = (2 if circuit.is_density_matrix else 1) * circuit.num_qubits
         self.num_amps = 1 << nsv
@@ -523,25 +531,33 @@ class Engine:
         from .. import fusion
 
         with fusion.pallas_mesh(self._mesh):
-            return self.circuit.parameterized(donate=self._donate)
+            return self.circuit.parameterized(donate=self._donate,
+                                              reduce=self._finalize)
 
     def _execB(self):
         """The vmap-over-params batch executable (unsharded registers):
         ONE fused program evolving ``max_batch`` states, batches padded to
         that size so the shape -- and hence the compiled program -- is
-        constant."""
+        constant. An armed ``finalize`` composes inside the vmapped body,
+        so the program returns ``max_batch`` finalized results (e.g. shot
+        tables) and the 2^N lanes never leave the device."""
         import jax
 
         from .. import fusion
         from ..parallel import scheduler as _dist
 
         key = ("param_vmap", self.fingerprint, self.max_batch, self.dtype.str,
-               self._donate)
+               self._donate, self._finalize)
         circuit, donate = self.circuit, self._donate
+        finalize = self._finalize
 
         def build():
             inner = circuit._replay_fn(circuit.lifted())
-            jitted = jax.jit(jax.vmap(inner, in_axes=(0, 0)),
+            if finalize is not None:
+                body = lambda amps, values: finalize(inner(amps, values))  # noqa: E731
+            else:
+                body = inner
+            jitted = jax.jit(jax.vmap(body, in_axes=(0, 0)),
                              donate_argnums=(0,) if donate else ())
 
             def fn(amps_b, values_b, _inner=jitted):
@@ -796,6 +812,10 @@ class Engine:
         retire passes the ISSUING dispatch's ordinal as ``tick`` so the
         sentinel tick tracks the batch being checked, not whatever the
         host has issued since."""
+        if self._finalize is not None:
+            # finalized results (shot tables, expectations) are not
+            # amps-shaped states -- the integrity sentinels don't apply
+            return amps
         if not _sentinel.enabled():
             return amps
         findings = _sentinel.check_amps(
@@ -812,10 +832,24 @@ class Engine:
         return amps
 
     def _maybe_corrupt(self, amps):
+        if self._finalize is not None:
+            # the corrupt injector flips amplitude words; a finalized
+            # result is an arbitrary pytree -- skip (chaos scenarios
+            # exercise the amps-returning routes)
+            return amps
         if not _faults.enabled():
             return amps
         from ..resilience import guard as _guard
         return _guard.corrupt_amps(amps)
+
+    def _lane(self, out, i: int):
+        """Lane ``i`` of a vmap batch result: a plain slice for the
+        amps-returning path, a tree_map'd slice when ``finalize`` made the
+        result an arbitrary pytree (e.g. ``{"shots": ..., "expec": ...}``)."""
+        if self._finalize is None:
+            return out[i]
+        import jax
+        return jax.tree_util.tree_map(lambda a: a[i], out)
 
     def _trace_done(self, req, rt0: float, rt1: float) -> None:
         """Record the resolve phase; finish engine-owned traces (adopted
@@ -1033,7 +1067,7 @@ class Engine:
         # The windows deliberately overlap -- phases tile each request's
         # own end-to-end latency, they are not a global partition.
         for i, req in enumerate(batch):
-            lane = self._maybe_corrupt(out[i])
+            lane = self._maybe_corrupt(self._lane(out, i))
             self._sentinel_gate(lane)
             if req.trace is not None:
                 self._trace_done(req, t_d, time.perf_counter())
@@ -1190,7 +1224,7 @@ class Engine:
                 self._ring.popleft()
                 telemetry.set_gauge("engine_async_inflight", len(self._ring))
                 for i, req in enumerate(batch):
-                    lane = self._maybe_corrupt(out[i])
+                    lane = self._maybe_corrupt(self._lane(out, i))
                     self._sentinel_gate(lane, tick=tick)
                     if req.trace is not None:
                         self._trace_done(req, t_ready, time.perf_counter())

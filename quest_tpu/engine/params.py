@@ -97,6 +97,10 @@ _LIFTABLE = {
     # slot -- T trajectories replay one compiled program with T seed
     # streams stacked by the engine's vmap batcher (quest_tpu/trajectories)
     "applyTrajectoryKraus": {2: _SEED, "seed": _SEED},
+    # mid-circuit measurement (round 19, sampling.measure): the draw seed
+    # is the same runtime uint32 slot kind -- S sampled requests replay
+    # one compiled program with S seed streams
+    "applyMidMeasurement": {1: _SEED, "seed": _SEED},
     "phaseShift": {1: _REAL, "angle": _REAL},
     "controlledPhaseShift": {2: _REAL, "angle": _REAL},
     "multiControlledPhaseShift": {1: _REAL, "angle": _REAL},

@@ -224,7 +224,7 @@ class EnginePool:
                  tenant_qps: int | None = None, admission=None,
                  precision_code: int | None = None, donate: bool = True,
                  spawn_replacements: bool = True,
-                 precompile_ms: float = 0.0):
+                 precompile_ms: float = 0.0, finalize=None):
         if replicas is None:
             replicas = _env_replicas()
         if replicas < 1:
@@ -237,10 +237,14 @@ class EnginePool:
             raise ValueError(
                 f"precompile_ms must be >= 0, got {precompile_ms}")
         self._env = env
+        # finalize (round 19): forwarded to every engine the pool builds --
+        # futures resolve to finalize(final_amps) (e.g. on-device shot
+        # tables) instead of amplitude arrays
         self._engine_kw = dict(max_batch=max_batch,
                                max_delay_ms=max_delay_ms,
                                queue_max=queue_max,
-                               precision_code=precision_code, donate=donate)
+                               precision_code=precision_code, donate=donate,
+                               finalize=finalize)
         self.hedge_s = float(hedge_ms) / 1e3
         self.admission = (admission if admission is not None
                           else AdmissionController(tenant_qps))
@@ -840,7 +844,8 @@ class EnginePool:
                 try:
                     if eng is not None and eng._open:
                         key = ("param_vmap", eng.fingerprint,
-                               eng.max_batch, eng.dtype.str, eng._donate)
+                               eng.max_batch, eng.dtype.str, eng._donate,
+                               eng._finalize)
                         if eng._mode() != "vmap" or \
                                 _ec.executables().peek(key) is not None:
                             telemetry.inc("engine_precompile_total",

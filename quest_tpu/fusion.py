@@ -252,7 +252,12 @@ def capture(fn, args, kwargs, num_qubits: int, dtype,
 
     # trajectory-noise sites (and anything else tagged _fusion_barrier)
     # assemble their operator at apply time from runtime PRNG draws: there
-    # is no static event to capture, even with a constant seed
+    # is no static event to capture, even with a constant seed. The
+    # mid-circuit measurement/collapse entries of sampling.measure carry
+    # the same tag: their one-hot collapse mask is a function of the
+    # runtime draw (or of the state's own marginal), so a measurement
+    # site is always a fusion barrier -- gate runs fuse up to it and
+    # resume after it, mirroring the segment seam it also forces.
     if getattr(fn, "_fusion_barrier", False):
         return None
 

@@ -527,6 +527,11 @@ def _prob_of_outcome(qureg: Qureg, target: int, outcome: int) -> float:
                                       target=target, outcome=outcome)
     else:
         p = M.prob_of_outcome(qureg.amps, n=nsv, target=target, outcome=outcome)
+    # the float() below is THE per-shot host round-trip the on-device
+    # sampler (quest_tpu.sampling) exists to avoid -- count it so the two
+    # readout routes are comparable in telemetry
+    from . import telemetry
+    telemetry.inc("measure_host_syncs_total")
     return float(p)
 
 

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from .layout import grouped_axes
+from .reduce import csum_rows
 
 
 def _acc_dtype():
@@ -45,14 +46,17 @@ def prob_of_outcome(amps, *, n: int, target: int, outcome: int):
 
 def _group_outcome_probs(p, n, targets):
     """Reorder a real 2^n tensor so target bits (targets[0]=LSB) lead, then
-    sum the rest; returns (2^t,)."""
+    sum the rest; returns (2^t,). The per-group accumulation is the
+    compensated rowwise cascade (ops.reduce.csum_rows): a bare
+    ``.sum(axis=1)`` drifts ~1e-5 against the f64 oracle at 20q f32
+    marginals, well past the sampler's CDF resolution."""
     t = len(targets)
     shape, axis_of = grouped_axes(n, targets)
     p = p.reshape(shape)
     targ_axes = [axis_of[q] for q in reversed(targets)]  # MSB first
     rest = [ax for ax in range(len(shape)) if ax not in targ_axes]
     p = p.transpose(tuple(targ_axes + rest))
-    return p.reshape((1 << t, -1)).sum(axis=1)
+    return csum_rows(p.reshape((1 << t, -1)))
 
 
 @partial(jax.jit, static_argnames=("n", "targets"))

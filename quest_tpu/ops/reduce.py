@@ -35,6 +35,18 @@ def _pairwise_sum(flat):
     return jnp.sum(flat)
 
 
+def _pairwise_sum_rows(x):
+    """Rowwise pairwise (cascade) summation over the LAST axis of a 2-D
+    array: the marginal-group analogue of :func:`_pairwise_sum`, same
+    O(log N) error growth and same adjacent (2i, 2i+1) pairing so every
+    add stays shard-local on block-sharded rows."""
+    m = x.shape[-1]
+    while m > 1 and m % 2 == 0:
+        x = x.reshape(x.shape[0], -1, 2).sum(axis=-1)
+        m //= 2
+    return jnp.sum(x, axis=-1)
+
+
 def _csum(x):
     """Compensated reduction of ``x`` (any shape).
 
@@ -48,6 +60,18 @@ def _csum(x):
     if jax.config.jax_enable_x64:
         return jnp.sum(x.astype(jnp.float64))
     return _pairwise_sum(x.reshape(-1))
+
+
+def csum_rows(x):
+    """Compensated ROWWISE reduction of a 2-D array over its last axis --
+    the marginal-group accumulation of ``ops.measure._group_outcome_probs``
+    (round 19: the bare ``.sum(axis=1)`` it replaces drifted ~1e-5 at 20q+
+    f32 marginals while the total-probability path already cascaded).
+    Same policy as :func:`_csum`: f64 accumulate when x64 is on, adjacent-
+    pair cascade otherwise."""
+    if jax.config.jax_enable_x64:
+        return jnp.sum(x.astype(jnp.float64), axis=-1)
+    return _pairwise_sum_rows(x)
 
 
 @jax.jit

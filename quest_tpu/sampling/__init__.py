@@ -1,0 +1,32 @@
+"""On-device batched sampling & mid-circuit measurement (round 19).
+
+Three layers (docs/sampling.md):
+
+- :mod:`.sampler` -- the inverse-CDF shot kernel: S shots of a request
+  as one fixed-shape jitted program over the sharded probability
+  reduction (two-level block CDF, f32 draws, compensated normalizer).
+- :mod:`.measure` -- ``applyMidMeasurement`` / ``applyMidCollapse``:
+  measurement and collapse as recordable tape items (fusion barriers,
+  segment seams, reconciliation points) with the branch-free one-hot
+  collapse of the trajectory engine.
+- :mod:`.request` -- one-dispatch request composition: circuit + shot
+  table + Pauli-sum expectation as ONE device program returning O(S)
+  bits, plus the eager ``sampleQureg`` convenience and the
+  ``QUEST_SHOTS`` default.
+"""
+
+from .measure import applyMidCollapse, applyMidMeasurement  # noqa: F401
+from .request import (  # noqa: F401
+    DEFAULT_SHOTS, expectation_reduce, sample_reduce, sample_request,
+    sampleQureg, shots_default, to_host,
+)
+from .sampler import (  # noqa: F401
+    draw_outcomes, marginal_probs, sample_density, sample_statevec,
+)
+
+__all__ = [
+    "applyMidCollapse", "applyMidMeasurement", "DEFAULT_SHOTS",
+    "draw_outcomes", "expectation_reduce", "marginal_probs",
+    "sample_density", "sample_reduce", "sample_request", "sample_statevec",
+    "sampleQureg", "shots_default", "to_host",
+]

@@ -1,0 +1,170 @@
+"""Batched on-device inverse-CDF shot sampling (round 19).
+
+The production readout of a simulator endpoint is S measurement samples,
+not 2^N amplitudes. The reference draws each shot through ``measure()`` --
+one probability reduction, one host float round-trip, one collapse per
+shot per qubit. Here all S shots of a request are ONE fixed-shape jitted
+program over the state's probability reduction, the batched-sampler shape
+of cuStateVec (arXiv:2308.01999): build the marginal CDF once, then every
+shot is a branch-free two-level inverse-CDF search.
+
+Structure of the search (``draw_outcomes``):
+
+- the 2^t marginal is reshaped into (B, L) blocks, B a power of two at
+  least the amps mesh size when one is active -- each block is then
+  shard-local, the within-block cumsums never cross a shard boundary,
+  and the (B,)-vector block CDF (cumsum of per-block partial sums) IS
+  the psum-scanned shard-offset table: a shot first counts its block
+  against that tiny table, then gathers ONE block row and counts inside
+  it. Per-shot work is O(B + L) = O(sqrt(2^t)) at the balanced split,
+  and the cross-shard traffic of a shot is one L-element row gather
+  from its owning shard, never the full distribution.
+- draws are float32 uniforms from the counter-based threefry stream
+  ``fold_in(PRNGKey(seed), site)`` regardless of the state's route --
+  the same cross-route discipline as ``trajectories.sample`` -- and the
+  CDF itself accumulates in float32, so f32/f64/df executions of one
+  seed walk the same inverse-CDF path whenever the marginal is exactly
+  representable (dyadic circuits) and agree to the marginal's own
+  cross-route ulp otherwise.
+- the draw is scaled by the COMPENSATED total probability
+  (``ops.reduce.total_prob_statevec`` / ``total_prob_density``), so
+  norm drift cannot push a shot off the CDF table; indices clamp
+  branch-free exactly like the trajectory Kraus selector.
+
+The shot count and target set are static (they are the program's shape);
+the seed is a runtime value -- lifted through the engine's ``'seed'``
+slot kind, S seeds replay one executable.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import measure as M, reduce as R
+
+__all__ = ["marginal_probs", "draw_outcomes", "sample_statevec",
+           "sample_density", "shot_key"]
+
+
+def shot_key(seed, site: int = 0):
+    """The counter-based PRNG key of one sampling site: every sampling
+    site of a tape gets its own threefry stream from one uint32 seed,
+    deterministic across shardings, devices and replays."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), int(site))
+
+
+def marginal_probs(amps, *, n: int, targets: tuple, density: bool = False):
+    """The 2^t outcome marginal of the planar state over ``targets``
+    (targets[0] = LSB of the outcome index), via the compensated rowwise
+    group sums of ``ops.measure`` -- float32 for the CDF build (see
+    module docstring). Traceable; no host sync."""
+    targets = tuple(int(t) for t in targets)
+    if density:
+        p = M.density_prob_of_all_outcomes(amps, n=n, targets=targets)
+    elif len(targets) == n:
+        # full-register marginal: |amp|^2 in amplitude order IS the
+        # outcome distribution when targets are (0..n-1); skip the
+        # transpose/group machinery entirely
+        if targets == tuple(range(n)):
+            p = amps[0] * amps[0] + amps[1] * amps[1]
+        else:
+            p = M.prob_of_all_outcomes(amps, n=n, targets=targets)
+    else:
+        p = M.prob_of_all_outcomes(amps, n=n, targets=targets)
+    return p.astype(jnp.float32)
+
+
+def _block_bits(t: int, mesh_devices: int | None) -> int:
+    """The block-count exponent of the (B, L) two-level split: balanced
+    (t // 2) for per-shot work O(sqrt(2^t)), raised to the shard-bit
+    count when an amps mesh is active so every block is shard-local."""
+    b = t // 2
+    if mesh_devices and mesh_devices > 1:
+        b = max(b, (int(mesh_devices) - 1).bit_length())
+    return min(b, t)
+
+
+def draw_outcomes(p, u, *, norm=None):
+    """Inverse-CDF draw of ``u.shape[0]`` shots from the (2^t,) float32
+    marginal ``p``: returns int32 outcome indices. ``u`` is the (S,)
+    float32 uniform vector; ``norm`` scales the draws (default: the
+    marginal's own compensated total) so the selection is
+    norm-proportional -- slight norm drift rescales every draw instead
+    of biasing the tail. Branch-free and fixed-shape: traceable inside
+    one jitted program for any S."""
+    t = int(p.shape[0]).bit_length() - 1
+    try:  # tracers may not expose a sharding; the balanced split is fine
+        mesh = getattr(getattr(p, "sharding", None), "mesh", None)
+        nd = mesh.size if mesh is not None else None
+    except Exception:
+        nd = None
+    bb = _block_bits(t, nd)
+    B, L = 1 << bb, 1 << (t - bb)
+    p2 = p.reshape(B, L)
+    # within-block CDF: ONE cumsum pass, shard-local rows
+    row_cdf = jnp.cumsum(p2, axis=1)
+    # per-block partial sums -> the scanned block-offset table (on a
+    # sharded state this is exactly the per-shard CDF partials plus the
+    # scan of shard offsets: B is aligned to the mesh, so entry b is the
+    # probability mass strictly before block b's shard-local span)
+    block_tot = row_cdf[:, -1]
+    block_cdf = jnp.cumsum(block_tot)
+    total = (block_cdf[-1] if norm is None
+             else jnp.asarray(norm, dtype=jnp.float32))
+    draws = u.astype(jnp.float32) * total
+
+    def one(draw):
+        b = jnp.minimum(jnp.sum((draw >= block_cdf).astype(jnp.int32)),
+                        B - 1)
+        offset = jnp.where(b > 0, block_cdf[jnp.maximum(b - 1, 0)],
+                           jnp.float32(0.0))
+        # gather ONE block row (L elements, from the owning shard) and
+        # count inside it -- the trajectory selector's branch-free
+        # ``sum(draw >= cdf)`` at the second level
+        row = jax.lax.dynamic_index_in_dim(row_cdf, b, axis=0,
+                                           keepdims=False)
+        j = jnp.minimum(jnp.sum((draw - offset >= row).astype(jnp.int32)),
+                        L - 1)
+        return (b * L + j).astype(jnp.int32)
+
+    return jax.vmap(one)(draws)
+
+
+def sample_statevec(amps, *, n: int, targets: tuple, shots: int, seed,
+                    site: int = 0):
+    """S = ``shots`` outcome draws over ``targets`` of a planar
+    state-vector, as one traceable fixed-shape computation: returns the
+    (S,) int32 shot table (targets[0] = LSB of each outcome). ``seed``
+    may be a plain int or a traced uint32 (the lifted seed slot);
+    ``site`` decorrelates distinct sampling sites of one tape."""
+    p = marginal_probs(amps, n=n, targets=tuple(targets))
+    norm = R.total_prob_statevec(amps).astype(jnp.float32)
+    u = jax.random.uniform(shot_key(seed, site), (int(shots),),
+                           dtype=jnp.float32)
+    return draw_outcomes(p, u, norm=norm)
+
+
+def sample_density(amps, *, n: int, targets: tuple, shots: int, seed,
+                   site: int = 0):
+    """The density-register variant of :func:`sample_statevec`: marginals
+    come from the diagonal, the normalizer from Re tr(rho)."""
+    p = marginal_probs(amps, n=n, targets=tuple(targets), density=True)
+    norm = R.total_prob_density(amps, n=n).astype(jnp.float32)
+    u = jax.random.uniform(shot_key(seed, site), (int(shots),),
+                           dtype=jnp.float32)
+    return draw_outcomes(p, u, norm=norm)
+
+
+@partial(jax.jit, static_argnames=("n", "targets", "shots", "site",
+                                   "density"))
+def sample_jit(amps, seed, *, n: int, targets: tuple, shots: int,
+               site: int = 0, density: bool = False):
+    """The eager entry point: one jitted program per (shape, targets,
+    shots) drawing all S shots on device; only the (S,) int32 table ever
+    crosses to the host."""
+    fn = sample_density if density else sample_statevec
+    return fn(amps, n=n, targets=targets, shots=shots, seed=seed,
+              site=site)

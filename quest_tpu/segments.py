@@ -163,6 +163,21 @@ def identity_boundaries(tape, nsv: int) -> list:
     return bounds
 
 
+def measurement_seams(tape) -> set:
+    """Tape indices that MUST be segment cuts because a measurement site
+    (round 19, ``quest_tpu.sampling.measure`` -- entries tagged
+    ``_measurement_site``) sits between them: the seam before and after
+    each site. Measurement sites are where recorded outcomes become
+    definite, so checkpoint/resume boundaries align with them exactly
+    like they align with frame identity."""
+    seams: set = set()
+    for i, (f, _a, _kw) in enumerate(tape):
+        if getattr(f, "_measurement_site", False):
+            seams.add(i)
+            seams.add(i + 1)
+    return seams
+
+
 def segment_cuts(tape, nsv: int, max_items: int | None = None) -> list:
     """Greedy coarsest identity-aligned cut list ``[0, ..., len(tape)]``:
     each segment is the LARGEST boundary-to-boundary span of at most
@@ -174,16 +189,27 @@ def segment_cuts(tape, nsv: int, max_items: int | None = None) -> list:
     segment (frames cannot be cut mid-flight). A tape that does not end
     at identity gets a final non-checkpointable segment to ``len(tape)``
     -- execution stays correct; only fused plans guarantee the QT102
-    tail."""
+    tail.
+
+    Measurement sites (:func:`measurement_seams`) force additional cuts:
+    a segment never spans across a mid-circuit measurement, so every
+    site starts (and ends) its own segment -- the seam where a recorded
+    outcome becomes definite. A seam that is not at frame identity is
+    skipped (the frame cannot be cut mid-flight; tapelint QT005 flags
+    that tape)."""
     if max_items is not None and max_items < 1:
         raise ValueError("max_items must be >= 1")
     bounds = identity_boundaries(tape, nsv)
     if bounds[-1] != len(tape):
         bounds.append(len(tape))
+    # forced measurement seams, restricted to legal (identity) boundaries
+    forced = sorted(measurement_seams(tape) & set(bounds))
     cuts = [0]
     while cuts[-1] < len(tape):
         start = cuts[-1]
-        nxt = [b for b in bounds if b > start]
+        fence = next((b for b in forced if b > start), None)
+        nxt = [b for b in bounds if b > start
+               and (fence is None or b <= fence)]
         if max_items is not None:
             capped = [b for b in nxt if b - start <= max_items]
             cuts.append(capped[-1] if capped else nxt[0])
@@ -350,7 +376,11 @@ def request_executable(circuit, donate: bool = True, reduce=None):
     ``reduce(amps)`` (a probability readout, an expectation contraction),
     composed inside a single ``jax.jit`` with the state buffer donated
     end-to-end -- intermediate segment states live and die inside the
-    one XLA program, never round-tripping through the host. A request
+    one XLA program, never round-tripping through the host. ``reduce``
+    may declare extra RUNTIME positional arguments after ``amps`` (the
+    round-19 shot sampler's PRNG seed); the returned executable passes
+    them through -- ``fn(amps, *extra)`` -- so value changes never touch
+    the cache key or the compiled structure. A request
     then touches the host exactly twice (submit, result) and
     ``device_dispatch_total{route="request"}`` counts exactly ONE launch
     per call: ``dispatches_per_circuit`` hits its floor of 1, where
@@ -386,21 +416,21 @@ def request_executable(circuit, donate: bool = True, reduce=None):
         replays = tuple(circuit._replay_fn(None, lo=a, hi=b)
                         for a, b in zip(bounds, bounds[1:]))
 
-        def whole(amps, _replays=replays, _reduce=reduce):
+        def whole(amps, *extra, _replays=replays, _reduce=reduce):
             for f in _replays:
                 amps = f(amps)
-            return amps if _reduce is None else _reduce(amps)
+            return amps if _reduce is None else _reduce(amps, *extra)
 
         inner = jax.jit(whole, donate_argnums=(0,) if donate else ())
 
-        def fn(amps, _inner=inner, _mesh=mesh, _pmesh=pmesh):
+        def fn(amps, *extra, _inner=inner, _mesh=mesh, _pmesh=pmesh):
             from .circuits import _amps_mesh
             pm = _pmesh if _pmesh is not None else _amps_mesh(amps)
             # ONE launch for the whole request -- the counter delta the
             # bench's dispatches_per_circuit row and native.yml gate read
             telemetry.inc("device_dispatch_total", route="request")
             with _dist.explicit_mesh(_mesh), fusion.pallas_mesh(pm):
-                return _inner(amps)
+                return _inner(amps, *extra)
 
         fn.num_segments = len(replays)
         fn.num_dispatches = 1

@@ -154,17 +154,55 @@ class TrajectoryResult:
     """One executed ensemble: ``states`` is the (T, 2, 2^n) planar stack in
     seed order, ``seeds`` the per-trajectory uint32 seeds, ``seed_name``
     the bound Param. ``density()`` gives the ensemble-mean density matrix
-    (small n only: it materialises 4^n complex entries)."""
-    states: np.ndarray
+    (small n only: it materialises 4^n complex entries).
+
+    When the ensemble sampled on device (``run_ensemble(..., shots=S)``),
+    ``shot_tables`` is the (T, S) int32 outcome stack and ``states`` is
+    None -- the 2^n trajectory states never left the device."""
+    states: np.ndarray | None
     seeds: tuple
     seed_name: str
+    shot_tables: np.ndarray | None = None
 
     @property
     def num_trajectories(self) -> int:
         return len(self.seeds)
 
     def density(self) -> np.ndarray:
+        if self.states is None:
+            raise QuESTError(
+                "TrajectoryResult.density() needs the trajectory states; "
+                "this ensemble sampled on device (shots=...) and only the "
+                "shot tables crossed to the host")
         return ensemble_density(self.states)
+
+
+#: the static sampling ``site`` of an ensemble's terminal shot stage --
+#: far above any tape's channel-site indices, so the shot stream never
+#: collides with a trajectory Kraus stream sharing the same uint32 seed.
+_SHOT_SITE = 1 << 16
+
+
+def _shot_finalize(*, n: int, targets: tuple, shots: int, shot_seed: int):
+    """A cached ``finalize(amps)`` drawing the per-trajectory shot table on
+    device (the Engine finalize hook). The draw uniforms are SHARED across
+    the vmap lanes of a batch (one static ``shot_seed``): common random
+    numbers -- each trajectory's table is still an unbiased sample of its
+    own outcome distribution, and cross-trajectory variance shrinks."""
+    from ..engine import cache as _ec
+    from ..sampling import sampler as _sampler
+    key = ("ensemble_shot_finalize", n, targets, int(shots),
+           int(shot_seed))
+
+    def build():
+        def finalize(amps):
+            return _sampler.sample_statevec(
+                amps, n=n, targets=targets, shots=int(shots),
+                seed=int(shot_seed), site=_SHOT_SITE)
+
+        return finalize
+
+    return _ec.executables().get_or_create(key, build)
 
 
 def run_ensemble(circuit: Circuit, num_trajectories: int | None = None, *,
@@ -174,7 +212,10 @@ def run_ensemble(circuit: Circuit, num_trajectories: int | None = None, *,
                  max_batch: int | None = None,
                  precision_code: int | None = None,
                  initial: object = "zero",
-                 timeout: float | None = None) -> TrajectoryResult:
+                 timeout: float | None = None,
+                 shots: int | None = None,
+                 shot_targets=None,
+                 shot_seed: int = 0) -> TrajectoryResult:
     """Execute a trajectory ensemble of ``circuit`` through the serving
     engine: one Engine per call, T = ``num_trajectories`` (default: the
     QUEST_TRAJECTORIES count) seed bindings submitted atomically so the
@@ -185,7 +226,15 @@ def run_ensemble(circuit: Circuit, num_trajectories: int | None = None, *,
     already-unraveled tape carrying exactly one named seed Param. ``seeds``
     overrides the default ``base_seed + t`` stream ids; ``params`` supplies
     any additional named Params the tape carries. Replaying with the same
-    seeds is bit-identical -- sharded or not, f32 or f64/df."""
+    seeds is bit-identical -- sharded or not, f32 or f64/df.
+
+    ``shots`` (round 19): sample S outcomes per trajectory ON DEVICE
+    (over ``shot_targets``, default all qubits, seeded by ``shot_seed``)
+    instead of returning the states -- the sampler composes into the
+    batched program via the Engine ``finalize`` hook, so a T-trajectory
+    S-shot ensemble moves T*S int32 words to the host, never T*2^n
+    amplitudes. The result's ``shot_tables`` is the (T, S) stack and
+    ``states`` is None."""
     from ..engine import Engine
 
     if circuit.is_density_matrix:
@@ -212,19 +261,38 @@ def run_ensemble(circuit: Circuit, num_trajectories: int | None = None, *,
             raise QuESTError("seeds must be non-empty")
     sites = sum(1 for fn, _, _ in circuit._tape
                 if getattr(fn, "__name__", "") == "applyTrajectoryKraus")
+    finalize = None
+    if shots is not None:
+        if int(shots) < 1:
+            raise QuESTError(f"shots must be >= 1, got {shots}")
+        if shot_targets is None:
+            shot_targets = tuple(range(circuit.num_qubits))
+        shot_targets = tuple(int(t) for t in shot_targets)
+        finalize = _shot_finalize(n=circuit.num_qubits,
+                                  targets=shot_targets, shots=int(shots),
+                                  shot_seed=int(shot_seed))
     mb = min(len(seeds), max_batch) if max_batch else len(seeds)
     eng = Engine(circuit, env, max_batch=mb, max_delay_ms=0.0,
-                 precision_code=precision_code, initial=initial)
+                 precision_code=precision_code, initial=initial,
+                 finalize=finalize)
     try:
         reqs = [dict(params or {}, **{seed_name: s}) for s in seeds]
         futs = eng.submit_many(reqs, timeout=timeout)
-        states = np.stack([np.asarray(f.result()) for f in futs])
+        results = np.stack([np.asarray(f.result()) for f in futs])
     finally:
         eng.close()
     telemetry.inc("trajectory_runs_total", len(seeds))
     telemetry.inc("trajectory_sites_total", sites * len(seeds))
     telemetry.inc("trajectory_ensembles_total")
+    if finalize is not None:
+        telemetry.inc("sample_shots_total", int(shots) * len(seeds))
+        telemetry.set_gauge("sample_host_transfer_bytes",
+                            int(results.nbytes))
     telemetry.event("trajectories.ensemble", trajectories=len(seeds),
-                    sites=sites, max_batch=mb, sharded=eng.sharded)
-    return TrajectoryResult(states=states, seeds=tuple(seeds),
+                    sites=sites, max_batch=mb, sharded=eng.sharded,
+                    shots=0 if shots is None else int(shots))
+    if finalize is not None:
+        return TrajectoryResult(states=None, seeds=tuple(seeds),
+                                seed_name=seed_name, shot_tables=results)
+    return TrajectoryResult(states=results, seeds=tuple(seeds),
                             seed_name=seed_name)
