@@ -1912,6 +1912,156 @@ def bench_sample(n: int, depth: int, shots: int, reps: int) -> dict:
     }
 
 
+def bench_vqe(n: int, depth: int, reps: int) -> dict:
+    """CI-gate config ``vqe_20q`` (round 20): the adjoint-mode gradient
+    engine (quest_tpu/gradients/, docs/gradients.md). Headline is
+    gradient-steps/sec through ``Engine.submit_grad`` at batch-8 (8
+    concurrent optimizer lanes coalesce into ONE vmapped gradient
+    program). The gate evidence rides in the detail: a warm sequential
+    loop proving ``dispatches_per_grad == 1``
+    (``device_dispatch_total{route=grad_request}`` deltas) and
+    ``retraces == 0`` (``engine_trace_total`` flat), plus an
+    adjoint-vs-``jax.grad`` A/B -- same circuit, same Hamiltonian, the
+    adjoint's ~3-sweep backward walk timed against reverse-mode AD
+    through the raw replay (which saves O(P) intermediate states), with
+    values and gradients asserted to agree."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+    from quest_tpu.calculations import expec_pauli_sum_amps
+    from quest_tpu.engine import Engine
+    from quest_tpu.precision import real_dtype
+
+    batch = 8
+    metric = (f"gradient-steps/sec, {n}q VQE ansatz adjoint gradients "
+              f"(batch-{batch} coalesced submit_grad lanes)")
+    env = qt.createQuESTEnv(jax.devices()[:1])
+    dtype = np.dtype(real_dtype())
+    atol = 1e-5 if dtype == np.float32 else 1e-12
+
+    circ = serving_ansatz(n, depth)
+    names = circ.param_names
+    rng = np.random.RandomState(20)
+    codes = rng.randint(0, 4, size=(6, n)).astype(np.int32)
+    coeffs = rng.normal(size=6)
+
+    def draw():
+        return {nm: float(v)
+                for nm, v in zip(names, rng.uniform(0, 2 * np.pi,
+                                                    len(names)))}
+
+    # --- adjoint-vs-jax.grad A/B leg (smaller size: reverse-mode AD
+    # through the replay checkpoints every intermediate state, O(P)
+    # memory -- the cost the adjoint method exists to avoid) ------------
+    n_ab = min(n, 14)
+    ab_circ = serving_ansatz(n_ab, depth)
+    ab_params = {nm: float(v) for nm, v in zip(
+        ab_circ.param_names,
+        rng.uniform(0, 2 * np.pi, len(ab_circ.param_names)))}
+    ab_codes = codes[:, :n_ab].copy()
+    gx = ab_circ.gradient((ab_codes, coeffs), donate=False)
+    q = qt.createQureg(n_ab, env)
+    amps_np = np.asarray(q.amps)
+    out = gx(q.amps, ab_params)
+    jax.block_until_ready(out["value"])
+    num_slots = len(out["slot_grads"])
+
+    lifted = ab_circ.lifted()
+    replay = ab_circ._replay_fn(lifted)
+    cf = jnp.asarray(coeffs, dtype=dtype)
+    codes_t = tuple(tuple(int(x) for x in row) for row in ab_codes)
+
+    @jax.jit
+    def value_fn(vals):
+        psi = replay(jnp.asarray(amps_np, dtype=dtype), vals)
+        return expec_pauli_sum_amps(psi, cf, codes=codes_t, n=n_ab,
+                                    density=False)
+
+    grad_fn = jax.jit(jax.grad(value_fn))
+    jvals = tuple(jnp.asarray(v) for v in gx.bind(ab_params))
+    ref_val = value_fn(jvals)
+    ref_grads = jax.block_until_ready(grad_fn(jvals))
+    grads_match_jax = bool(
+        abs(float(out["value"]) - float(ref_val)) <= atol
+        and all(np.allclose(np.asarray(g), np.asarray(rg), atol=atol,
+                            rtol=0)
+                for g, rg in zip(out["slot_grads"], ref_grads)))
+    best_adj = best_ad = float("inf")
+    for _ in range(max(min(reps, 3), 1)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(gx(jnp.asarray(amps_np), ab_params)["value"])
+        best_adj = min(best_adj, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.block_until_ready((value_fn(jvals), grad_fn(jvals)))
+        best_ad = min(best_ad, time.perf_counter() - t0)
+
+    # --- the serving legs: warm loop accounting + batch-8 throughput --
+    eng = Engine(circ, env, hamiltonian=(codes, coeffs), max_batch=batch,
+                 max_delay_ms=0.5)
+    try:
+        base = draw()
+        eng.warmup_grad(base)
+        # warm batch-8 round untimed: traces the padded vmap width once
+        [f.result(timeout=600)
+         for f in [eng.submit_grad(draw()) for _ in range(batch)]]
+        tr0 = telemetry.counter_value("engine_trace_total",
+                                      kind="param_replay")
+        d0 = telemetry.counter_value("device_dispatch_total",
+                                     route="grad_request")
+        g0 = telemetry.counter_value("grad_requests_total")
+        steps = 6
+        for step in range(steps):
+            p = {k: v + 0.01 * step for k, v in base.items()}
+            eng.submit_grad(p).result(timeout=600)
+        retraces = int(telemetry.counter_value(
+            "engine_trace_total", kind="param_replay") - tr0)
+        dispatches = int(telemetry.counter_value(
+            "device_dispatch_total", route="grad_request") - d0)
+        grad_reqs = int(telemetry.counter_value("grad_requests_total") - g0)
+        dispatches_per_grad = dispatches / max(grad_reqs, 1)
+        best_batch = float("inf")
+        for _ in range(max(min(reps, 3), 1)):
+            sweep = [draw() for _ in range(batch)]
+            t0 = time.perf_counter()
+            futs = [eng.submit_grad(p) for p in sweep]
+            outs = [f.result(timeout=600) for f in futs]
+            best_batch = min(best_batch, time.perf_counter() - t0)
+        assert len(outs) == batch and all(
+            len(grads) == len(names) for _, grads in outs)
+        rate = batch / best_batch
+    finally:
+        eng.close()
+
+    return {
+        "config": "vqe_20q",
+        "metric": metric,
+        "value": round(rate, 2),
+        "unit": "grad-steps/sec",
+        "vs_baseline": None,
+        "detail": {
+            "qubits": n,
+            "depth": depth,
+            "batch": batch,
+            "params": len(names),
+            "grad_steps_per_sec": round(rate, 2),
+            "batch_ms": round(best_batch * 1e3, 2),
+            "warm_steps": steps,
+            "retraces": retraces,
+            "dispatches_per_grad": dispatches_per_grad,
+            "ab_qubits": n_ab,
+            "ab_params": num_slots,
+            "adjoint_ms": round(best_adj * 1e3, 2),
+            "jax_grad_ms": round(best_ad * 1e3, 2),
+            "adjoint_vs_jax_grad": round(best_ad / best_adj, 2),
+            "grads_match_jax": grads_match_jax,
+        },
+    }
+
+
 def _trajectories_config(reps: int, smoke: bool) -> dict:
     """Run the trajectories_20q row, re-execing into an 8-virtual-device
     subprocess when this process's backend has a single device, so the
@@ -2029,7 +2179,7 @@ def main() -> None:
                             "f64", "plan_f64", "plan_34q_f64",
                             "20q", "24q", "26q", "serve", "resilience",
                             "sentinel", "comm", "trajectories",
-                            "dispatch", "pool", "sample"],
+                            "dispatch", "pool", "sample", "vqe"],
                    default="all",
                    help="all: every BASELINE.json milestone config (default);"
                         " statevec: one random Clifford+T run at --qubits;"
@@ -2075,7 +2225,11 @@ def main() -> None:
                         " sampling: shots/sec at batch-8 via the Engine"
                         " finalize hook, one-dispatch request leg with"
                         " sampled-marginals-vs-oracle + fixed-seed"
-                        " shot-table replay bit-identity asserted)")
+                        " shot-table replay bit-identity asserted);"
+                        " vqe: the vqe_20q row (adjoint-mode gradient"
+                        " engine: grad-steps/sec at batch-8 via"
+                        " submit_grad, adjoint-vs-jax.grad A/B,"
+                        " retraces==0 + dispatches_per_grad==1 asserted)")
     p.add_argument("--emit", choices=["headline", "full"],
                    default="headline",
                    help="headline: compact <=1KB final line + "
@@ -2209,6 +2363,10 @@ def main() -> None:
                          8192 if args.smoke else 65536, args.reps)
         _emit(r, [r], args.emit)
         return
+    if args.config == "vqe":
+        r = bench_vqe(20, 2 if args.smoke else 4, args.reps)
+        _emit(r, [r], args.emit)
+        return
     if args.config in ("20q", "24q", "26q"):
         r = bench_statevec(int(args.config[:-1]), args.depth, args.reps,
                            sync)
@@ -2270,6 +2428,11 @@ def main() -> None:
             # marginals vs the exact oracle, fixed-seed shot-table
             # replay bit-identity, batch-8 shots/sec (ISSUE 18 gate)
             cfgs.append(bench_sample(20, 2, 8192, 3))
+            # ... and the vqe row: adjoint-mode gradients served as
+            # first-class traffic -- one grad_request dispatch per step,
+            # zero warm retraces, batch-8 grad-steps/sec and the
+            # adjoint-vs-jax.grad A/B (ISSUE 19 gate)
+            cfgs.append(bench_vqe(20, 2, 3))
         _emit(r, cfgs, args.emit)
         return
 
@@ -2319,6 +2482,7 @@ def main() -> None:
     configs.append(bench_dispatch(20, 4, args.reps))
     configs.append(bench_pool(20, 4, args.reps))
     configs.append(bench_sample(20, 4, 65536, args.reps))
+    configs.append(bench_vqe(20, 4, args.reps))
     # headline = the 26q statevec config, selected by metric string so list
     # reordering can never silently change what is reported
     headline = dict(next(c for c in configs

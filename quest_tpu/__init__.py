@@ -75,5 +75,7 @@ from . import sampling  # noqa: F401
 from .sampling import (  # noqa: F401
     applyMidCollapse, applyMidMeasurement, sampleQureg, sample_request,
 )
+from . import gradients  # noqa: F401
+from .gradients import gradient_executable, parameter_shift  # noqa: F401
 
 __version__ = "0.1.0"

@@ -76,6 +76,13 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "marginal in RAW amplitude order, but the frame is not at "
               "identity there: move the site to an identity boundary or "
               "let the scheduler reconcile before it"),
+    "QT006": ("error", "non-differentiable site in a tape submitted for "
+                       "differentiation",
+              "the adjoint backward sweep cannot invert a mid-circuit "
+              "measurement or trajectory-Kraus site: submit the unitary "
+              "tape as a grad_request and compose the measurement / "
+              "noise statistics as a separate sample_request "
+              "(quest_tpu.sampling.request) on the forward state"),
     # -- QT1xx: plan verification -------------------------------------------
     "QT101": ("error", "dense kernel-op target outside the legal "
                        "physical tile",

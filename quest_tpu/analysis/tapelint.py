@@ -33,6 +33,15 @@ that sit inside a deferred-relocation window: their marginal reduction
 reads raw amplitude order, so the frame must be at identity there
 (:func:`..segments.identity_boundaries`).
 
+With ``differentiate=True`` (a tape headed for ``Circuit.gradient`` /
+the adjoint engine, quest_tpu/gradients) one more check runs: **QT006**
+flags every mid-circuit measurement/collapse and trajectory-Kraus site
+-- stochastic seams the adjoint backward sweep cannot invert.
+``Circuit.gradient`` raises a typed error at the first such site; the
+lint reports them ALL at record time, with the fix hint pointing at
+``sample_request`` composition (run the gradient on the unitary tape,
+sample the measurement separately).
+
 Entries the spy cannot capture (operator entries, Param-carrying
 entries, inits) act as lint barriers, exactly as they act as fusion
 barriers -- nothing is matched across them.
@@ -161,9 +170,12 @@ def _lint_traj_kraus(args, kwargs, where: str) -> list[Finding]:
 
 
 def lint_tape(tape, num_qubits: int, *, is_density: bool = False,
-              dtype=None, location: str = "tape") -> list[Finding]:
+              dtype=None, location: str = "tape",
+              differentiate: bool = False) -> list[Finding]:
     """Lint a recorded tape (list of ``(fn, args, kwargs)`` entries); see
-    the module docstring for the lint classes."""
+    the module docstring for the lint classes. ``differentiate=True``
+    additionally runs QT006 (non-differentiable sites) for tapes headed
+    to :meth:`..circuits.Circuit.gradient`."""
     from ..engine.params import _LIFTABLE, lift_slot_census
     from ..fusion import capture
     from ..precision import real_dtype
@@ -185,6 +197,18 @@ def lint_tape(tape, num_qubits: int, *, is_density: bool = False,
         where = f"{location}[{idx}]:{name}"
         if name == "applyTrajectoryKraus":
             findings.extend(_lint_traj_kraus(args, kwargs, where))
+        # QT006: a stochastic seam in a tape submitted for differentiation
+        # -- the adjoint backward sweep (quest_tpu/gradients) cannot invert
+        # a measurement or a sampled Kraus selection
+        if differentiate and (getattr(fn, "_measurement_site", False)
+                              or name == "applyTrajectoryKraus"):
+            what = ("trajectory-Kraus" if name == "applyTrajectoryKraus"
+                    else "mid-circuit measurement/collapse")
+            findings.append(make_finding(
+                "QT006",
+                f"{what} site '{name}' at entry [{idx}] in a tape "
+                f"submitted for differentiation: the adjoint sweep has "
+                f"no inverse for it", where))
         # QT005: a mid-circuit measurement/collapse site reduces the
         # target's marginal in RAW amplitude order -- inside a deferred-
         # relocation window (frame not at identity) that marginal is over
@@ -266,11 +290,11 @@ def lint_tape(tape, num_qubits: int, *, is_density: bool = False,
     return findings
 
 
-def lint_circuit(circuit, *, location: Optional[str] = None
-                 ) -> list[Finding]:
+def lint_circuit(circuit, *, location: Optional[str] = None,
+                 differentiate: bool = False) -> list[Finding]:
     """:func:`lint_tape` over a :class:`..circuits.Circuit`."""
     loc = location if location is not None else \
         f"circuit({circuit.num_qubits}q)"
     return lint_tape(list(circuit._tape), circuit.num_qubits,
                      is_density=circuit.is_density_matrix,
-                     location=loc)
+                     location=loc, differentiate=differentiate)

@@ -17,8 +17,8 @@ __all__ = [
     "calcTotalProb", "calcProbOfOutcome", "calcProbOfAllOutcomes",
     "calcInnerProduct", "calcDensityInnerProduct", "calcPurity", "calcFidelity",
     "calcHilbertSchmidtDistance", "calcExpecPauliProd", "calcExpecPauliSum",
-    "calcExpecPauliHamil", "getAmp", "getRealAmp", "getImagAmp", "getProbAmp",
-    "getDensityAmp",
+    "calcExpecPauliHamil", "calcGradExpecPauliSum", "getAmp", "getRealAmp",
+    "getImagAmp", "getProbAmp", "getDensityAmp",
 ]
 
 
@@ -250,6 +250,29 @@ def calcExpecPauliHamil(qureg: Qureg, hamil: PauliHamil, workspace: Qureg) -> fl
     V.validate_pauli_hamil(hamil, func)
     V.validate_hamil_matches_qureg(qureg, hamil, func)
     return calcExpecPauliSum(qureg, hamil.pauli_codes, hamil.term_coeffs, workspace)
+
+
+def calcGradExpecPauliSum(qureg: Qureg, circuit, all_pauli_codes,
+                          term_coeffs, params=None):
+    """Value and parameter gradients of ``sum_t c_t <P_t>`` after applying
+    ``circuit`` to ``qureg``'s current state, by the adjoint-state method
+    (quest_tpu/gradients, docs/gradients.md): one forward sweep, one
+    Hamiltonian application, one backward sweep -- versus 2P full replays
+    for parameter-shift. ``qureg`` is read, never written. Returns
+    ``(value, grads)`` with ``grads`` a name -> float dict over the
+    circuit's named :class:`~quest_tpu.engine.P` parameters. This is the
+    one-shot convenience; the serving route is :meth:`Engine.submit_grad`
+    / :meth:`EnginePool.submit_grad` over the same executable."""
+    from .gradients import gradient_executable
+
+    func = "calcGradExpecPauliSum"
+    V._assert(not qureg.is_density_matrix,
+              "calcGradExpecPauliSum needs a state-vector register (the "
+              "adjoint sweep differentiates pure states).", func)
+    out = gradient_executable(circuit, (all_pauli_codes, term_coeffs),
+                              donate=False)(qureg.amps, params)
+    return float(out["value"]), {k: float(v) for k, v in
+                                 out["grads"].items()}
 
 
 # ---------------------------------------------------------------------------

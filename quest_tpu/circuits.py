@@ -443,7 +443,14 @@ class Circuit:
 
         def build():
             body = self._replay_fn(lifted)
-            if reduce is not None:
+            if reduce is not None and getattr(reduce, "wants_values", False):
+                # values-aware reduce (the adjoint gradient sweep): the
+                # terminal stage sees the bound slot values too, so the
+                # backward walk re-assembles daggered gates from the same
+                # traced scalars the forward replay used
+                whole = lambda amps, values: reduce(body(amps, values),  # noqa: E731
+                                                    values)
+            elif reduce is not None:
                 whole = lambda amps, values: reduce(body(amps, values))  # noqa: E731
             else:
                 whole = body
@@ -458,6 +465,22 @@ class Circuit:
 
         return ParamExecutable(_ec.executables().get_or_create(key, build),
                                lifted, fp)
+
+    def gradient(self, hamiltonian, *, donate: bool = True, dtype=None):
+        """Compile the tape's adjoint-state gradient against a Pauli-sum
+        Hamiltonian (:mod:`quest_tpu.gradients`): one forward sweep, one
+        backward walk daggering every gate while harvesting ⟨λ|∂G/∂θ|φ⟩
+        per slot -- all lowered into ONE jitted program dispatched as
+        ``route=grad_request``. Returns a
+        :class:`~quest_tpu.gradients.GradExecutable` called as
+        ``grad(amps, {"theta": 0.3}) -> {"value", "grads", "slot_grads"}``.
+
+        Non-invertible tape items (measurement, trajectory noise,
+        channels) raise a typed :class:`QuESTError` here, at lift time,
+        naming the offending site."""
+        from .gradients import gradient_executable
+        return gradient_executable(self, hamiltonian, donate=donate,
+                                   dtype=dtype)
 
     def fused(self, max_qubits: int = 5, dtype=None,
               pallas: bool = False, shard_devices: int | None = None,

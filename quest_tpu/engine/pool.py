@@ -252,6 +252,11 @@ class EnginePool:
         self._cv = _sync.Condition("pool.cv")
         self._replicas: list[_Replica] = []
         self._manifest: dict = {}         # fingerprint -> circuit
+        # round 20: per-fingerprint finalize overrides -- gradient traffic
+        # rides the ordinary routing/failover machinery under a derived
+        # "grad:<ham>:<fp>" fingerprint whose engines are built with the
+        # adjoint grad_reduce finalize instead of the pool-wide one
+        self._finalize_for: dict = {}
         self._freq: dict = {}             # fingerprint -> request count
         self._pending = {p: deque() for p in PRIORITIES}
         self._next_rid = 0
@@ -294,9 +299,13 @@ class EnginePool:
 
     def submit_many(self, circuit, params_list, *, tenant: str = "default",
                     priority: str = "normal",
-                    timeout: float | None = None) -> list:
+                    timeout: float | None = None,
+                    _fingerprint: str | None = None) -> list:
         """Admit ``len(params_list)`` requests atomically (the quota sees
-        one take), then route each independently."""
+        one take), then route each independently. ``_fingerprint``
+        (internal) overrides the routing key -- submit_grad derives one
+        per (structure, observable) so gradient engines never collide
+        with plain replay engines of the same ansatz."""
         if priority not in PRIORITIES:
             raise ValueError(
                 f"priority must be one of {PRIORITIES}, got {priority!r}")
@@ -332,7 +341,8 @@ class EnginePool:
         t_admitted = time.perf_counter() if tracing else 0.0
         telemetry.inc("pool_requests_total", len(params_list),
                       tenant=tenant, priority=priority)
-        fp = circuit.fingerprint()
+        fp = _fingerprint if _fingerprint is not None \
+            else circuit.fingerprint()
         with self._cv:
             self._manifest.setdefault(fp, circuit)
             # per-structure frequency telemetry: the precompiler's ranking
@@ -358,6 +368,65 @@ class EnginePool:
     def run(self, circuit, params: dict | None = None, **kw):
         """Synchronous convenience: ``submit(...).result()``."""
         return self.submit(circuit, params, **kw).result()
+
+    # -- gradients (round 20) -----------------------------------------------
+
+    def submit_grad(self, circuit, params: dict | None = None, *,
+                    hamiltonian, tenant: str = "default",
+                    priority: str = "normal",
+                    timeout: float | None = None) -> Future:
+        """Route one variational optimizer step fleet-wide: a Future
+        resolving to ``(value, grads)`` from the adjoint gradient engine
+        for ``circuit`` against ``hamiltonian`` (a PauliHamil or
+        ``(pauli_codes, term_coeffs)``)."""
+        return self.submit_grad_many(circuit, [params],
+                                     hamiltonian=hamiltonian, tenant=tenant,
+                                     priority=priority, timeout=timeout)[0]
+
+    def submit_grad_many(self, circuit, params_list, *, hamiltonian,
+                         tenant: str = "default", priority: str = "normal",
+                         timeout: float | None = None) -> list:
+        """Batch form of :meth:`submit_grad`: gradient requests share the
+        ordinary admission/affinity/failover machinery under a derived
+        fingerprint, coalescing into the replica's vmapped
+        ``route=grad_request`` program."""
+        import hashlib
+
+        from ..gradients import grad_reduce
+        from ..precision import real_dtype
+
+        red = grad_reduce(
+            circuit, hamiltonian,
+            dtype=real_dtype(self._engine_kw.get("precision_code")))
+        ham_key = hashlib.sha1(
+            repr(red.hamiltonian).encode()).hexdigest()[:12]
+        gfp = f"grad:{ham_key}:{circuit.fingerprint()}"
+        with self._cv:
+            self._finalize_for[gfp] = red
+        telemetry.inc("grad_requests_total", len(params_list))
+        telemetry.inc("grad_slots_total",
+                      float(red.num_slots * len(params_list)))
+        inner = self.submit_many(circuit, params_list, tenant=tenant,
+                                 priority=priority, timeout=timeout,
+                                 _fingerprint=gfp)
+        outs = []
+        for f in inner:
+            fut: Future = Future()
+
+            def _chain(src, _fut=fut):
+                exc = src.exception()
+                if exc is not None:
+                    _sync.resolve_future(_fut, exception=exc,
+                                         site="pool.submit_grad")
+                else:
+                    out = src.result()
+                    _sync.resolve_future(
+                        _fut, result=(out["value"], out["grads"]),
+                        site="pool.submit_grad")
+
+            f.add_done_callback(_chain)
+            outs.append(fut)
+        return outs
 
     # -- routing ------------------------------------------------------------
 
@@ -675,9 +744,22 @@ class EnginePool:
         with rep.build_lock:
             with self._cv:
                 eng = rep.engines.get(fingerprint)
+                override = self._finalize_for.get(fingerprint)
             if eng is not None:
                 return eng
-            eng = Engine(circuit, self._env, **self._engine_kw)
+            kw = self._engine_kw
+            if override is not None:
+                kw = {**kw, "finalize": override}
+            elif isinstance(fingerprint, str) and \
+                    fingerprint.startswith("grad:"):
+                # a grad manifest row without its registered observable
+                # (e.g. replayed into a fresh pool) must fail loud -- a
+                # plain engine under this key would serve amps where the
+                # caller expects (value, grads)
+                raise KeyError(
+                    f"gradient fingerprint {fingerprint[:24]}... has no "
+                    "registered observable; route it through submit_grad")
+            eng = Engine(circuit, self._env, **kw)
             with self._cv:
                 rep.engines[fingerprint] = eng
             return eng

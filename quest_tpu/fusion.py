@@ -292,6 +292,30 @@ def _null_ctx(events):
     yield
 
 
+def event_dagger(ev: GateEvent) -> GateEvent:
+    """The exact inverse of a captured unitary event, as a new event.
+
+    Unitary kinds only: 'matrix' conjugate-transposes its block, 'diag'
+    conjugates its diagonal, 'parity' negates its angle, 'x' and 'swap'
+    are self-inverse. 'channel'/'aux' events (and ``extended`` density
+    shadows) are not unitary -- no inverse exists; raising here is what
+    lets the adjoint gradient planner (quest_tpu/gradients/adjoint.py)
+    turn "cannot invert" into a typed lift-time error naming the site.
+    """
+    if ev.kind == "matrix" and ev.matrix is not None and not ev.extended:
+        return GateEvent("matrix", ev.targets, ev.controls, ev.states,
+                         matrix=np.conj(np.asarray(ev.matrix)).T)
+    if ev.kind == "diag" and ev.diag is not None and not ev.extended:
+        return GateEvent("diag", ev.targets, ev.controls, ev.states,
+                         diag=np.conj(np.asarray(ev.diag)))
+    if ev.kind == "parity":
+        return GateEvent("parity", ev.targets, ev.controls, ev.states,
+                         theta=-ev.theta)
+    if ev.kind in ("x", "swap"):
+        return ev
+    raise ValueError(f"'{ev.kind}' event has no unitary inverse")
+
+
 # ---------------------------------------------------------------------------
 # dense embedding of one event into a block's qubit space
 # ---------------------------------------------------------------------------

@@ -1,0 +1,27 @@
+"""Adjoint-mode gradient engine: variational traffic as first-class
+requests (ROADMAP item 3; Jones & Gacon, arXiv:2009.02823).
+
+- :mod:`.adjoint` -- the reverse sweep itself: ``grad_reduce`` lowers
+  forward + backward + per-slot accumulation into one values-aware reduce
+  the replay/batcher compose into a single ``route=grad_request`` program;
+  ``gradient_executable`` is the host-facing compile (``Circuit.gradient``).
+- :mod:`.expectation` -- Pauli-sum Hamiltonian normalisation and the
+  λ = H|ψ⟩ costate build, scheduler-aware.
+- :mod:`.shift` -- parameter-shift rules, the independent correctness
+  oracle (2-4 replays per parameter; never the serving path).
+
+Serving entry points live on the engine: ``Engine.submit_grad(params)``
+batches T optimizer chains into one vmapped gradient program,
+``EnginePool.submit_grad`` routes them fleet-wide.
+"""
+
+from .adjoint import (GradExecutable, check_differentiable, grad_reduce,
+                      gradient_executable, plan_backward)
+from .expectation import apply_hamiltonian, expectation_value, hamiltonian_terms
+from .shift import parameter_shift
+
+__all__ = [
+    "GradExecutable", "check_differentiable", "grad_reduce",
+    "gradient_executable", "plan_backward", "apply_hamiltonian",
+    "expectation_value", "hamiltonian_terms", "parameter_shift",
+]

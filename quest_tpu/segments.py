@@ -402,6 +402,14 @@ def request_executable(circuit, donate: bool = True, reduce=None):
     from . import fusion
     from .engine import cache as _ec
     from .parallel import scheduler as _dist
+    if getattr(reduce, "wants_values", False):
+        from .validation import QuESTError
+        raise QuESTError(
+            "request_executable replays a concrete tape and has no "
+            "parameter-values vector to hand a wants_values reduce (the "
+            "gradient engine's grad_reduce); use Circuit.gradient / "
+            "Engine.submit_grad for the one-dispatch grad_request route",
+            "request_executable")
     sched = _dist.active()
     mesh = sched.mesh if sched else None
     pmesh = fusion.active_pallas_mesh()

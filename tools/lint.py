@@ -34,6 +34,11 @@ Five targets, one finding stream:
       carries an open span leaked an instrumentation handle. This is
       what the CI trace-smoke gate runs over the dryrun's export.
 
+``--differentiate`` layers the QT006 gradient lint onto the --qasm and
+--module targets: measurement/trajectory sites the adjoint engine
+(quest_tpu/gradients, docs/gradients.md) cannot invert are reported
+as errors with the sample_request composition hint.
+
 Exit status 1 when any error-severity finding is reported (the CI gate
 contract); warnings/info exit 0. ``--format json`` prints the
 machine-readable ``{"findings": [...], "summary": {...}}`` shape.
@@ -159,11 +164,13 @@ def _circuits_from_module(spec: str) -> list:
     return out
 
 
-def _lint_circuit_fully(circ, name: str) -> list:
+def _lint_circuit_fully(circ, name: str, differentiate: bool = False
+                        ) -> list:
     """Tape lint + fused-plan frame/ring check for one circuit."""
     from quest_tpu import analysis as A
 
-    findings = A.lint_circuit(circ, location=f"{name}.tape")
+    findings = A.lint_circuit(circ, location=f"{name}.tape",
+                              differentiate=differentiate)
     try:
         fz = circ.fused(max_qubits=5, pallas=True)
         nsv = (2 if circ.is_density_matrix else 1) * circ.num_qubits
@@ -190,6 +197,11 @@ def main(argv=None) -> int:
     tgt.add_argument("--trace", metavar="FILE",
                      help="check an export_traces JSON file for QT702 "
                           "open-span findings")
+    ap.add_argument("--differentiate", action="store_true",
+                    help="lint --qasm/--module circuits as tapes headed "
+                         "for Circuit.gradient: QT006 flags measurement/"
+                         "trajectory sites the adjoint sweep cannot "
+                         "invert (docs/gradients.md)")
     args = ap.parse_args(argv)
 
     _bootstrap_env(args.bench_plans)
@@ -208,11 +220,13 @@ def main(argv=None) -> int:
         findings = A.check_trace_file(args.trace)
     elif args.qasm:
         findings = _lint_circuit_fully(read_qasm(args.qasm),
-                                       os.path.basename(args.qasm))
+                                       os.path.basename(args.qasm),
+                                       differentiate=args.differentiate)
     else:
         for i, circ in enumerate(_circuits_from_module(args.module)):
             findings += _lint_circuit_fully(
-                circ, f"{args.module}[{i}]")
+                circ, f"{args.module}[{i}]",
+                differentiate=args.differentiate)
 
     print(A.render_json(findings) if args.format == "json"
           else A.render_text(findings))
