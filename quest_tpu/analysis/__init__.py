@@ -1,8 +1,8 @@
 """Static-analysis subsystem: prove schedule invariants before execution.
 
-Five checkers over one diagnostics framework (:mod:`.diagnostics`;
+The checkers share one diagnostics framework (:mod:`.diagnostics`;
 codes ``QT0xx`` lint / ``QT1xx`` plan / ``QT2xx`` kernel / ``QT6xx``
-concurrency):
+concurrency / ``QT7xx`` tracing / ``QT9xx`` surface parity):
 
 - :mod:`.plancheck` -- symbolic FusePlan frame replay and scheduler
   journal re-pricing (the model-vs-plan gate),
@@ -20,7 +20,13 @@ concurrency):
   (``tools/lint.py --concurrency``),
 - :mod:`.tracecheck` -- request-trace integrity (QT702 open spans in
   finished traces, QT703 trace contexts leaked across pooled-thread
-  reuse; ``tools/lint.py --trace FILE``).
+  reuse; ``tools/lint.py --trace FILE``),
+- :mod:`.surface` -- the QT9xx API-surface parity auditor: the vendored
+  reference L5 manifest audited (AST + inspect, zero-device) against
+  the live exports into the committed ``PARITY.md`` / ``parity.json``
+  fact table (``tools/lint.py --surface``, docs/parity.md), with
+  :mod:`.conformance` carrying the generated dense-oracle replay specs
+  the harness in tests/test_conformance.py walks.
 
 Reachable three ways: the ``tools/lint.py`` CLI, the pytest suites, and
 ``QUEST_VERIFY=1`` runtime gating -- :func:`verify_plan` runs at
@@ -48,6 +54,12 @@ from .plancheck import (check_circuit_comm, check_plan, check_schedule,
 from .ringcheck import check_events, check_ring, ring_events, sweep_reachable
 from .tapelint import lint_circuit, lint_events, lint_tape
 from .tracecheck import check_live_traces, check_trace_file, check_traces
+from .surface import (FACT_COLUMNS, REFERENCE_MANIFEST, ManifestEntry,
+                      SurfaceAudit, SurfaceRow, audit_surface,
+                      check_manifest_files, check_surface, parity_json,
+                      render_parity_md, write_manifest_files)
+from .conformance import (ORACLE_SPECS, ROUTE_MATRIX_NAMES, ConformanceCase,
+                          conformance_cases, route_cases)
 
 __all__ = [
     "Finding", "AnalysisError", "CATALOG", "SEVERITIES",
@@ -63,6 +75,12 @@ __all__ = [
     "lint_concurrency", "check_raw_locks", "check_atomicity",
     "check_traces", "check_live_traces", "check_trace_file",
     "verify_enabled", "verify_plan", "check_smoke_spec",
+    "ManifestEntry", "SurfaceRow", "SurfaceAudit", "REFERENCE_MANIFEST",
+    "FACT_COLUMNS", "audit_surface", "check_surface",
+    "check_manifest_files", "write_manifest_files", "render_parity_md",
+    "parity_json",
+    "ConformanceCase", "ORACLE_SPECS", "ROUTE_MATRIX_NAMES",
+    "conformance_cases", "route_cases",
 ]
 
 _VERIFY_ENV = "QUEST_VERIFY"

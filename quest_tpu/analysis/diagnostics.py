@@ -300,6 +300,36 @@ CATALOG: dict[str, tuple[str, str, str]] = {
               "set QUEST_SHOTS to an integer >= 1; the malformed value "
               "warns once per process and the default shot count is "
               "used"),
+    # -- QT9xx: API-surface parity audit (analysis/surface.py,
+    #    docs/parity.md) ----------------------------------------------------
+    "QT901": ("error", "reference L5 function missing from the public "
+                       "surface",
+              "a REFERENCE_MANIFEST row has no callable quest_tpu "
+              "export: implement it (or, if the reference really dropped "
+              "it, remove the vendored manifest row in the same PR)"),
+    "QT902": ("error", "public signature drifted from the vendored "
+                       "manifest",
+              "parameter names must match the manifest row verbatim -- "
+              "callers port QuEST programs against these names; update "
+              "the function or (for a deliberate API change) the "
+              "manifest row, never silently"),
+    "QT903": ("error", "public L5 function skips the validation layer",
+              "the function takes user input but no direct or delegated "
+              "validate_* call was found: add the guard (quest_tpu/"
+              "validation.py) and a VALIDATION_CASES regression entry, "
+              "or mark the manifest row needs_validation=False when "
+              "there is genuinely nothing to check"),
+    "QT904": ("warning", "L5 function has no tier-1 test call site",
+              "no literal call under tests/ exercises this function; "
+              "add an ORACLE_SPECS conformance row or a direct test"),
+    "QT905": ("error", "committed parity manifest is stale",
+              "PARITY.md / parity.json no longer match the audited "
+              "tree; regenerate with `python tools/lint.py --surface "
+              "--write` and commit the result"),
+    "QT906": ("warning", "L5 export is undocumented",
+              "give the function a docstring and regenerate the "
+              "docs/api pages (python tools/gen_docs.py) so the "
+              "documented column flips green"),
 }
 
 

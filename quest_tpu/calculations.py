@@ -30,6 +30,7 @@ def calcTotalProb(qureg: Qureg) -> float:
 
 
 def calcProbOfOutcome(qureg: Qureg, target: int, outcome: int) -> float:
+    """Probability of measuring ``outcome`` on ``measureQubit`` (QuEST.h:276)."""
     func = "calcProbOfOutcome"
     V.validate_target(qureg, target, func)
     V.validate_outcome(outcome, func)
@@ -280,6 +281,7 @@ def calcGradExpecPauliSum(qureg: Qureg, circuit, all_pauli_codes,
 # ---------------------------------------------------------------------------
 
 def getAmp(qureg: Qureg, index: int) -> complex:
+    """One statevector amplitude as a complex (QuEST.h:286)."""
     func = "getAmp"
     V.validate_state_vec(qureg, func)
     V.validate_amp_index(qureg, index, func)
@@ -287,14 +289,17 @@ def getAmp(qureg: Qureg, index: int) -> complex:
 
 
 def getRealAmp(qureg: Qureg, index: int) -> float:
+    """Real part of one statevector amplitude (QuEST.h:287)."""
     return getAmp(qureg, index).real
 
 
 def getImagAmp(qureg: Qureg, index: int) -> float:
+    """Imaginary part of one statevector amplitude (QuEST.h:288)."""
     return getAmp(qureg, index).imag
 
 
 def getProbAmp(qureg: Qureg, index: int) -> float:
+    """|amp|^2 of one statevector amplitude (QuEST.h:289)."""
     a = getAmp(qureg, index)
     return a.real * a.real + a.imag * a.imag
 

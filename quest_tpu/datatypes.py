@@ -110,6 +110,9 @@ def destroyComplexMatrixN(matrix) -> None:
 
 def initComplexMatrixN(matrix: np.ndarray, real, imag) -> None:
     """Overwrite a matrix from real/imag nested lists (initComplexMatrixN, QuEST.c)."""
+    func = "initComplexMatrixN"
+    validation.validate_matrix_init(matrix, func)
+    validation.validate_matrix_init_dims(matrix, real, imag, func)
     matrix[...] = np.asarray(real) + 1j * np.asarray(imag)
 
 
@@ -318,6 +321,7 @@ class SubDiagonalOp:
 
 
 def createSubDiagonalOp(num_qubits: int) -> SubDiagonalOp:
+    """Allocate a diagonal operator over a qubit subset (QuEST.h:185)."""
     validation.validate_num_qubits(num_qubits, "createSubDiagonalOp")
     return SubDiagonalOp(num_qubits, np.zeros(2 ** num_qubits, dtype=np.complex128))
 

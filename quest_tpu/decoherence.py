@@ -155,8 +155,10 @@ def mixNonTPKrausMap(qureg: Qureg, target: int, ops) -> None:
 
 
 def mixNonTPTwoQubitKrausMap(qureg: Qureg, q1: int, q2: int, ops) -> None:
+    """Two-qubit Kraus map WITHOUT completeness validation (QuEST.h:270)."""
     _mix_kraus(qureg, (q1, q2), ops, "mixNonTPTwoQubitKrausMap", False)
 
 
 def mixNonTPMultiQubitKrausMap(qureg: Qureg, targets, ops) -> None:
+    """Kraus map on many targets WITHOUT completeness validation (QuEST.h:271)."""
     _mix_kraus(qureg, tuple(targets), ops, "mixNonTPMultiQubitKrausMap", False)

@@ -147,6 +147,7 @@ def reportQuESTEnv(env: QuESTEnv) -> None:
 
 
 def getEnvironmentString(env: QuESTEnv) -> str:
+    """Fill ``env_str`` with the execution-environment summary (QuEST.h:123)."""
     n = env.num_ranks
     return f"CUDA=0 OpenMP=0 MPI=0 TPU=1 threads=1 ranks={n} devices={n}"
 
@@ -160,6 +161,7 @@ def seedQuEST(env: QuESTEnv, seeds: Sequence[int]) -> None:
     """Seed the measurement RNG from a user key array. numpy's MT19937 seeds
     arrays via init_by_array -- the same routine the reference feeds
     (QuEST_common.c:209-217)."""
+    validation.validate_num_seeds(seeds, "seedQuEST")
     env.seeds = [int(s) for s in seeds]
     env.rng = np.random.RandomState(np.asarray(env.seeds, dtype=np.uint32))
 
@@ -170,4 +172,5 @@ def seedQuESTDefault(env: QuESTEnv) -> None:
 
 
 def getQuESTSeeds(env: QuESTEnv) -> list[int]:
+    """The seeds the env's RNG was last seeded with (QuEST.h:126)."""
     return list(env.seeds)

@@ -142,12 +142,14 @@ def multiControlledPhaseShift(qureg: Qureg, qubits, angle: float) -> None:
 
 
 def controlledPhaseFlip(qureg: Qureg, q1: int, q2: int) -> None:
+    """Controlled-Z: phase -1 on the |11> subspace (QuEST.h:211)."""
     V.validate_control_target(qureg, q1, q2, "controlledPhaseFlip")
     _apply_gate_diag(qureg, np.array([1.0, -1.0]), (q2,), (q1,))
     if _log(qureg): _log(qureg).record_controlled_gate("sigmaZ", q1, q2)
 
 
 def multiControlledPhaseFlip(qureg: Qureg, qubits) -> None:
+    """Phase -1 on the all-ones subspace of ``controls`` (QuEST.h:212)."""
     V.validate_multi_targets(qureg, qubits, "multiControlledPhaseFlip")
     _apply_gate_diag(qureg, np.array([1.0, -1.0]), (qubits[0],), tuple(qubits[1:]))
     if _log(qureg):
@@ -155,30 +157,35 @@ def multiControlledPhaseFlip(qureg: Qureg, qubits) -> None:
 
 
 def sGate(qureg: Qureg, target: int) -> None:
+    """Phase gate diag(1, i) (QuEST.h:213)."""
     V.validate_target(qureg, target, "sGate")
     _apply_gate_diag(qureg, np.array([1.0, 1.0j]), (target,))
     if _log(qureg): _log(qureg).record_gate("sGate", target)
 
 
 def tGate(qureg: Qureg, target: int) -> None:
+    """T gate diag(1, exp(i pi/4)) (QuEST.h:214)."""
     V.validate_target(qureg, target, "tGate")
     _apply_gate_diag(qureg, np.array([1.0, np.exp(0.25j * math.pi)]), (target,))
     if _log(qureg): _log(qureg).record_gate("tGate", target)
 
 
 def pauliZ(qureg: Qureg, target: int) -> None:
+    """sigma-Z (QuEST.h:231)."""
     V.validate_target(qureg, target, "pauliZ")
     _apply_gate_diag(qureg, np.array([1.0, -1.0]), (target,))
     if _log(qureg): _log(qureg).record_gate("sigmaZ", target)
 
 
 def rotateZ(qureg: Qureg, target: int, angle: float) -> None:
+    """exp(-i angle/2 Z) (QuEST.h:219)."""
     V.validate_target(qureg, target, "rotateZ")
     _apply_gate_diag(qureg, matrices.rz_diag(angle), (target,))
     if _log(qureg): _log(qureg).record_param_gate("rotateZ", target, angle)
 
 
 def controlledRotateZ(qureg: Qureg, control: int, target: int, angle: float) -> None:
+    """Controlled exp(-i angle/2 Z) (QuEST.h:223)."""
     V.validate_control_target(qureg, control, target, "controlledRotateZ")
     _apply_gate_diag(qureg, matrices.rz_diag(angle), (target,), (control,))
     if _log(qureg): _log(qureg).record_controlled_param_gate("rotateZ", control, target, angle)
@@ -223,12 +230,14 @@ def diagonalUnitary(qureg: Qureg, targets, op: SubDiagonalOp) -> None:
 # ---------------------------------------------------------------------------
 
 def pauliX(qureg: Qureg, target: int) -> None:
+    """sigma-X (QuEST.h:229)."""
     V.validate_target(qureg, target, "pauliX")
     _apply_gate_x(qureg, (target,))
     if _log(qureg): _log(qureg).record_gate("sigmaX", target)
 
 
 def controlledNot(qureg: Qureg, control: int, target: int) -> None:
+    """CNOT (QuEST.h:233)."""
     V.validate_control_target(qureg, control, target, "controlledNot")
     _apply_gate_x(qureg, (target,), (control,))
     if _log(qureg): _log(qureg).record_controlled_gate("sigmaX", control, target)
@@ -256,18 +265,21 @@ def multiControlledMultiQubitNot(qureg: Qureg, controls, targets) -> None:
 # ---------------------------------------------------------------------------
 
 def hadamard(qureg: Qureg, target: int) -> None:
+    """Hadamard gate (QuEST.h:232)."""
     V.validate_target(qureg, target, "hadamard")
     _apply_gate_matrix(qureg, matrices.HADAMARD, (target,))
     if _log(qureg): _log(qureg).record_gate("hadamard", target)
 
 
 def pauliY(qureg: Qureg, target: int) -> None:
+    """sigma-Y (QuEST.h:230)."""
     V.validate_target(qureg, target, "pauliY")
     _apply_gate_matrix(qureg, matrices.PAULI_Y_M, (target,))
     if _log(qureg): _log(qureg).record_gate("sigmaY", target)
 
 
 def controlledPauliY(qureg: Qureg, control: int, target: int) -> None:
+    """Controlled sigma-Y (QuEST.h:236)."""
     V.validate_control_target(qureg, control, target, "controlledPauliY")
     _apply_gate_matrix(qureg, matrices.PAULI_Y_M, (target,), (control,))
     if _log(qureg): _log(qureg).record_controlled_gate("sigmaY", control, target)
@@ -284,6 +296,7 @@ def compactUnitary(qureg: Qureg, target: int, alpha: complex, beta: complex) -> 
 
 def controlledCompactUnitary(qureg: Qureg, control: int, target: int,
                              alpha: complex, beta: complex) -> None:
+    """Controlled [[alpha, -conj(beta)], [beta, conj(alpha)]] (QuEST.h:225)."""
     func = "controlledCompactUnitary"
     V.validate_control_target(qureg, control, target, func)
     V.validate_unitary_complex_pair(alpha, beta, qureg.eps, func)
@@ -293,6 +306,7 @@ def controlledCompactUnitary(qureg: Qureg, control: int, target: int,
 
 
 def unitary(qureg: Qureg, target: int, u) -> None:
+    """General single-qubit unitary, unitarity-validated (QuEST.h:216)."""
     func = "unitary"
     V.validate_target(qureg, target, func)
     V.validate_unitary_matrix(u, 1, qureg.eps, func)
@@ -301,6 +315,7 @@ def unitary(qureg: Qureg, target: int, u) -> None:
 
 
 def controlledUnitary(qureg: Qureg, control: int, target: int, u) -> None:
+    """Controlled general single-qubit unitary (QuEST.h:226)."""
     func = "controlledUnitary"
     V.validate_control_target(qureg, control, target, func)
     V.validate_unitary_matrix(u, 1, qureg.eps, func)
@@ -309,6 +324,7 @@ def controlledUnitary(qureg: Qureg, control: int, target: int, u) -> None:
 
 
 def multiControlledUnitary(qureg: Qureg, controls, target: int, u) -> None:
+    """Multi-control general single-qubit unitary (QuEST.h:227)."""
     func = "multiControlledUnitary"
     V.validate_multi_controls_multi_targets(qureg, controls, (target,), func)
     V.validate_unitary_matrix(u, 1, qureg.eps, func)
@@ -333,18 +349,21 @@ def multiStateControlledUnitary(qureg: Qureg, controls, states, target: int, u) 
 # ---------------------------------------------------------------------------
 
 def rotateX(qureg: Qureg, target: int, angle: float) -> None:
+    """exp(-i angle/2 X) (QuEST.h:217)."""
     V.validate_target(qureg, target, "rotateX")
     _apply_gate_matrix(qureg, matrices.rx_matrix(angle), (target,))
     if _log(qureg): _log(qureg).record_param_gate("rotateX", target, angle)
 
 
 def rotateY(qureg: Qureg, target: int, angle: float) -> None:
+    """exp(-i angle/2 Y) (QuEST.h:218)."""
     V.validate_target(qureg, target, "rotateY")
     _apply_gate_matrix(qureg, matrices.ry_matrix(angle), (target,))
     if _log(qureg): _log(qureg).record_param_gate("rotateY", target, angle)
 
 
 def rotateAroundAxis(qureg: Qureg, target: int, angle: float, axis: Vector) -> None:
+    """exp(-i angle/2 n.sigma) about a Bloch-sphere axis (QuEST.h:220)."""
     func = "rotateAroundAxis"
     V.validate_target(qureg, target, func)
     V.validate_vector(axis, func)
@@ -353,12 +372,14 @@ def rotateAroundAxis(qureg: Qureg, target: int, angle: float, axis: Vector) -> N
 
 
 def controlledRotateX(qureg: Qureg, control: int, target: int, angle: float) -> None:
+    """Controlled exp(-i angle/2 X) (QuEST.h:221)."""
     V.validate_control_target(qureg, control, target, "controlledRotateX")
     _apply_gate_matrix(qureg, matrices.rx_matrix(angle), (target,), (control,))
     if _log(qureg): _log(qureg).record_controlled_param_gate("rotateX", control, target, angle)
 
 
 def controlledRotateY(qureg: Qureg, control: int, target: int, angle: float) -> None:
+    """Controlled exp(-i angle/2 Y) (QuEST.h:222)."""
     V.validate_control_target(qureg, control, target, "controlledRotateY")
     _apply_gate_matrix(qureg, matrices.ry_matrix(angle), (target,), (control,))
     if _log(qureg): _log(qureg).record_controlled_param_gate("rotateY", control, target, angle)
@@ -366,6 +387,7 @@ def controlledRotateY(qureg: Qureg, control: int, target: int, angle: float) -> 
 
 def controlledRotateAroundAxis(qureg: Qureg, control: int, target: int,
                                angle: float, axis: Vector) -> None:
+    """Controlled rotation about an arbitrary Bloch axis (QuEST.h:224)."""
     func = "controlledRotateAroundAxis"
     V.validate_control_target(qureg, control, target, func)
     V.validate_vector(axis, func)
@@ -451,6 +473,7 @@ def swapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
 
 
 def sqrtSwapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
+    """Square root of SWAP (QuEST.h:238)."""
     V.validate_unique_targets(qureg, qb1, qb2, "sqrtSwapGate")
     _apply_gate_matrix(qureg, matrices.SQRT_SWAP, (qb1, qb2))
     if _log(qureg): _log(qureg).record_controlled_gate("sqrtSwap", qb1, qb2)
@@ -467,6 +490,7 @@ def twoQubitUnitary(qureg: Qureg, t1: int, t2: int, u) -> None:
 
 
 def controlledTwoQubitUnitary(qureg: Qureg, control: int, t1: int, t2: int, u) -> None:
+    """Single-control dense two-target unitary (QuEST.h:244)."""
     func = "controlledTwoQubitUnitary"
     V.validate_multi_controls_multi_targets(qureg, (control,), (t1, t2), func)
     V.validate_unitary_matrix(u, 2, qureg.eps, func)
@@ -476,6 +500,7 @@ def controlledTwoQubitUnitary(qureg: Qureg, control: int, t1: int, t2: int, u) -
 
 
 def multiControlledTwoQubitUnitary(qureg: Qureg, controls, t1: int, t2: int, u) -> None:
+    """Multi-control dense two-target unitary (QuEST.h:245)."""
     func = "multiControlledTwoQubitUnitary"
     V.validate_multi_controls_multi_targets(qureg, controls, (t1, t2), func)
     V.validate_unitary_matrix(u, 2, qureg.eps, func)
@@ -496,6 +521,7 @@ def multiQubitUnitary(qureg: Qureg, targets, u) -> None:
 
 
 def controlledMultiQubitUnitary(qureg: Qureg, control: int, targets, u) -> None:
+    """Single-control dense multi-target unitary (QuEST.h:247)."""
     func = "controlledMultiQubitUnitary"
     V.validate_multi_controls_multi_targets(qureg, (control,), targets, func)
     V.validate_matrix_init(u, func)

@@ -87,6 +87,7 @@ def applyMatrix2(qureg: Qureg, target: int, u) -> None:
 
 
 def applyMatrix4(qureg: Qureg, t1: int, t2: int, u) -> None:
+    """Left-multiply a general 4x4 matrix, not necessarily unitary (QuEST.h:298)."""
     func = "applyMatrix4"
     V.validate_multi_targets(qureg, (t1, t2), func)
     V.validate_matrix_size(u, 2, func)
@@ -95,6 +96,7 @@ def applyMatrix4(qureg: Qureg, t1: int, t2: int, u) -> None:
 
 
 def applyMatrixN(qureg: Qureg, targets, u) -> None:
+    """Left-multiply a general 2^N x 2^N matrix, not necessarily unitary (QuEST.h:299)."""
     func = "applyMatrixN"
     V.validate_multi_targets(qureg, targets, func)
     V.validate_matrix_init(u, func)
@@ -115,6 +117,7 @@ def applyGateMatrixN(qureg: Qureg, targets, u) -> None:
 
 
 def applyMultiControlledMatrixN(qureg: Qureg, controls, targets, u) -> None:
+    """Left-multiply a controlled general matrix, not necessarily unitary (QuEST.h:301)."""
     func = "applyMultiControlledMatrixN"
     V.validate_multi_controls_multi_targets(qureg, controls, targets, func)
     V.validate_matrix_init(u, func)
@@ -474,6 +477,7 @@ def applyParamNamedPhaseFuncOverrides(qureg: Qureg, qubits_flat, num_qubits_per_
 # ---------------------------------------------------------------------------
 
 def createDiagonalOp(num_qubits: int, env) -> DiagonalOp:
+    """Allocate an all-zero 2^N diagonal operator in the env (QuEST.h:175)."""
     func = "createDiagonalOp"
     V.validate_num_qubits(num_qubits, func)
     V.validate_num_amps_fit_type(num_qubits, False, func)
@@ -494,6 +498,7 @@ def createDiagonalOp(num_qubits: int, env) -> DiagonalOp:
 
 
 def destroyDiagonalOp(op: DiagonalOp, env=None) -> None:
+    """Release a DiagonalOp's device buffers (QuEST.h:176)."""
     try:
         op.elems.delete()
     except Exception:
@@ -507,6 +512,7 @@ def syncDiagonalOp(op: DiagonalOp) -> None:
 
 
 def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
+    """Overwrite a DiagonalOp's elements from real/imag arrays (QuEST.h:178)."""
     func = "initDiagonalOp"
     V.validate_diag_op_init(op, func)
     reals = np.asarray(reals).reshape(-1)
@@ -522,6 +528,7 @@ def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
 
 
 def setDiagonalOpElems(op: DiagonalOp, start_ind: int, reals, imags, num_elems: int) -> None:
+    """Overwrite a slice of a DiagonalOp's elements (QuEST.h:181)."""
     func = "setDiagonalOpElems"
     V.validate_diag_op_init(op, func)
     V.validate_num_elems(op, start_ind, num_elems, func)

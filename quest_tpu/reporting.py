@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import validation
 from .registers import Qureg, get_np
 
 __all__ = [
@@ -27,6 +28,7 @@ def reportState(qureg: Qureg) -> None:
 
 
 def reportStateToScreen(qureg: Qureg, env=None, report_rank: int = 0) -> None:
+    """Print every amplitude to stdout, rank-prefixed (QuEST.h:317)."""
     amps = get_np(qureg)
     print("Reporting state from rank 0 of 1")
     for a in amps:
@@ -51,20 +53,30 @@ def reportPauliHamil(hamil) -> None:
 
 
 def startRecordingQASM(qureg: Qureg) -> None:
+    """Begin recording subsequent gates as QASM (QuEST.h:319)."""
     qureg.qasm_log.start()
 
 
 def stopRecordingQASM(qureg: Qureg) -> None:
+    """Pause QASM recording; the buffer is kept (QuEST.h:320)."""
     qureg.qasm_log.stop()
 
 
 def clearRecordedQASM(qureg: Qureg) -> None:
+    """Discard the QASM recorded so far (QuEST.h:321)."""
     qureg.qasm_log.clear()
 
 
 def printRecordedQASM(qureg: Qureg) -> None:
+    """Print the recorded QASM to stdout (QuEST.h:322)."""
     print(qureg.qasm_log.printed(), end="")
 
 
 def writeRecordedQASMToFile(qureg: Qureg, filename: str) -> None:
-    qureg.qasm_log.write_to_file(filename)
+    """Flush the recorded QASM to ``filename``; an unopenable path raises
+    through the validation layer (validateFileOpened, QuEST_qasm.c:855)."""
+    try:
+        qureg.qasm_log.write_to_file(filename)
+    except OSError:
+        validation.validate_file_opened(False, filename,
+                                        "writeRecordedQASMToFile")

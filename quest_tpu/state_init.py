@@ -37,6 +37,7 @@ def initBlankState(qureg: Qureg) -> None:
 
 
 def initZeroState(qureg: Qureg) -> None:
+    """Set the register to |0...0> (QuEST.h:194)."""
     if qureg.is_density_matrix:
         amps = I.density_init_classical(qureg.num_amps_total, qureg.dtype, 0)
     else:
@@ -46,6 +47,7 @@ def initZeroState(qureg: Qureg) -> None:
 
 
 def initPlusState(qureg: Qureg) -> None:
+    """Set the register to |+>^n, every amplitude equal (QuEST.h:195)."""
     if qureg.is_density_matrix:
         amps = I.density_init_plus(qureg.num_amps_total, qureg.dtype)
     else:
@@ -55,6 +57,7 @@ def initPlusState(qureg: Qureg) -> None:
 
 
 def initClassicalState(qureg: Qureg, state_index: int) -> None:
+    """Set the register to computational basis state |stateInd> (QuEST.h:196)."""
     func = "initClassicalState"
     V.validate_state_index(qureg, state_index, func)
     if qureg.is_density_matrix:
@@ -163,9 +166,11 @@ def setWeightedQureg(fac1: complex, qureg1: Qureg, fac2: complex, qureg2: Qureg,
 
 
 def getNumQubits(qureg: Qureg) -> int:
+    """Number of qubits the register represents (QuEST.h:134)."""
     return qureg.num_qubits_represented
 
 
 def getNumAmps(qureg: Qureg) -> int:
+    """Number of statevector amplitudes, 2^numQubits (QuEST.h:135)."""
     V.validate_state_vec(qureg, "getNumAmps")
     return qureg.num_amps_total

@@ -456,6 +456,25 @@ def validate_file_opened(opened: bool, path: str, func: str) -> None:
     _assert(opened, f"Could not open file ({path}).", func)
 
 
+def validate_num_seeds(seeds, func: str) -> None:
+    """seedQuEST's key array must carry at least one seed: numpy's
+    ``init_by_array`` (like the reference's mt19937 ``init_by_array``,
+    QuEST_common.c:209-217) rejects an empty key."""
+    _assert(len(seeds) > 0,
+            "Invalid number of seeds. Must use at least 1 seed.", func)
+
+
+def validate_matrix_init_dims(matrix, real, imag, func: str) -> None:
+    """initComplexMatrixN's planes must both match the created matrix
+    dimension (the reference indexes caller rows blindly here; we check)."""
+    m = _as_matrix(matrix)
+    r = np.asarray(real)
+    i = np.asarray(imag)
+    _assert(r.shape == m.shape and i.shape == m.shape,
+            "The real/imag components must match the dimension of the "
+            "created matrix.", func)
+
+
 def validate_hamil_file_params(num_qubits: int, num_terms: int, path: str,
                                func: str) -> None:
     _assert(num_qubits > 0 and num_terms > 0,

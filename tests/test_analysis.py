@@ -338,5 +338,6 @@ def test_catalog_codes_are_banded():
         band = int(code[2])
         # 0=tape lint, 1=plan verify, 2=DMA ring, 3=resilience/runtime,
         # 4=integrity sentinels / watchdog, 5=trajectory noise engine,
-        # 6=concurrency verifier, 7=request tracing, 8=sampling
-        assert band in (0, 1, 2, 3, 4, 5, 6, 7, 8)
+        # 6=concurrency verifier, 7=request tracing, 8=sampling,
+        # 9=API-surface parity auditor
+        assert band in (0, 1, 2, 3, 4, 5, 6, 7, 8, 9)

@@ -28,6 +28,10 @@ import numpy as np
 import pytest
 
 import quest_tpu as qt
+# the reference's Catch2 generators, shared with the QT9xx conformance
+# harness (quest_tpu/analysis/conformance.py, docs/parity.md)
+from quest_tpu.analysis.conformance import (ctrl_targ_splits, pauliseqs,
+                                            sublists, subsets)
 
 from . import oracle
 from .helpers import NUM_QUBITS, TOL, get_density, get_statevec, set_density, set_statevec
@@ -41,37 +45,6 @@ ENV = qt.createQuESTEnv(jax.devices()[:1])
 RNG = np.random.RandomState(314)
 DIM = 1 << NUM_QUBITS
 QUBITS = tuple(range(NUM_QUBITS))
-
-
-def sublists(items, min_len=1, max_len=None):
-    """Every ordered k-sublist (permutation of every combination), as the
-    reference's `sublists` generator (tests/utilities.hpp:1124)."""
-    max_len = len(items) if max_len is None else max_len
-    for k in range(min_len, max_len + 1):
-        yield from itertools.permutations(items, k)
-
-
-def subsets(items, min_len=1):
-    for k in range(min_len, len(items) + 1):
-        yield from itertools.combinations(items, k)
-
-
-def ctrl_targ_splits(items, max_targs=None):
-    """Every (controls, targets) partition with both non-empty and disjoint,
-    as the reference's paired sublist enumeration."""
-    items = set(items)
-    for targs in sublists(sorted(items), 1, max_targs):
-        rest = sorted(items - set(targs))
-        for nc in range(1, len(rest) + 1):
-            for ctrls in itertools.combinations(rest, nc):
-                yield ctrls, targs
-
-
-def pauliseqs(targets):
-    """Every non-identity Pauli code sequence on ``targets``, as the
-    reference's `pauliseqs` (identity-only sequences excluded)."""
-    for codes in itertools.product((1, 2, 3), repeat=len(targets)):
-        yield codes
 
 
 def _fresh_statevec():

@@ -208,6 +208,15 @@ VALIDATION_CASES = [
     ("getStaticComplexMatrixN", lambda: qt.getStaticComplexMatrixN([[1, 0], [0, 1]])),
     ("bindArraysToStackComplexMatrixN",
      lambda: qt.bindArraysToStackComplexMatrixN(2, [[1.0]], [[0.0]])),
+    # QT903 fix-ups (PR 20, docs/parity.md): functions the surface audit
+    # caught skipping the validation layer
+    ("seedQuEST", lambda: qt.seedQuEST(ENV, [])),
+    ("initComplexMatrixN",
+     lambda: qt.initComplexMatrixN(qt.createComplexMatrixN(1),
+                                   [[1.0]], [[0.0]])),
+    ("writeRecordedQASMToFile",
+     lambda: qt.writeRecordedQASMToFile(
+         _sv(), "/nonexistent-dir-quest/recorded.qasm")),
 ]
 
 

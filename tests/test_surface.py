@@ -1,0 +1,199 @@
+"""The QT9xx API-surface parity auditor's own test suite
+(quest_tpu/analysis/surface.py, docs/parity.md).
+
+Two halves:
+
+- clean-tree assertions -- the shipped tree must audit with zero
+  QT901/QT902/QT903 errors and fresh committed PARITY.md/parity.json
+  (the same contract the CI surface-audit gate enforces), and
+- seeded-mutation tests -- a dropped function, a drifted signature, a
+  stripped validator, a vanished test call site, a missing docstring
+  and a tampered/missing manifest file are each injected through
+  audit_surface()'s injectable inputs and must be caught by the
+  matching QT9xx code.  An auditor that cannot see a seeded fault
+  guards nothing.
+"""
+
+import json
+
+import pytest
+
+import quest_tpu
+from quest_tpu.analysis import surface as S
+
+
+@pytest.fixture(scope="module")
+def audit():
+    """One full scan of the real tree, shared by the clean-tree tests."""
+    return S.audit_surface()
+
+
+# ---------------------------------------------------------------------------
+# clean-tree contract (what CI gates)
+# ---------------------------------------------------------------------------
+
+def test_manifest_shape(audit):
+    assert len(S.REFERENCE_MANIFEST) == 156
+    assert len(audit.rows) == len(S.REFERENCE_MANIFEST)
+    names = [r.name for r in audit.rows]
+    assert len(set(names)) == len(names)
+
+
+def test_clean_tree_has_no_parity_errors(audit):
+    codes = sorted(f.code for f in audit.findings)
+    assert "QT901" not in codes, codes
+    assert "QT902" not in codes, codes
+    assert "QT903" not in codes, codes
+
+
+def test_clean_tree_core_columns_full(audit):
+    s = audit.summary()
+    n = len(audit.rows)
+    for col in ("exists", "signature", "validates", "documented", "tested"):
+        assert s[col] == n, (col, s)
+
+
+def test_committed_manifest_files_fresh(audit):
+    # the QT905 gate over the files actually committed at the repo root
+    assert S.check_manifest_files(audit) == []
+
+
+def test_parity_json_round_trips(audit):
+    doc = json.loads(S.parity_json(audit))
+    assert doc["total"] == len(audit.rows)
+    assert list(doc["columns"]) == list(S.FACT_COLUMNS)
+    [h] = [r for r in doc["functions"] if r["name"] == "hadamard"]
+    assert h["facts"]["exists"] is True
+    assert doc["summary"] == audit.summary()
+
+
+def test_validation_fixpoint_sees_delegation():
+    # functions that validate only through a module-local helper must be
+    # green: mixKrausMap -> _mix_kraus, applyFullQFT -> _qft_on -> hadamard
+    vset = S.scan_validated()
+    assert "mixKrausMap" in vset
+    assert "multiRotatePauli" in vset
+    assert "applyFullQFT" in vset
+
+
+# ---------------------------------------------------------------------------
+# seeded mutations: each fault class must be caught
+# ---------------------------------------------------------------------------
+
+def _entry(name="hadamard", params=("qureg", "target"), **kw):
+    return S.ManifestEntry(name, tuple(params), "statevec", "gates", **kw)
+
+
+def _run(manifest, namespace, **overrides):
+    """audit_surface with every scan input stubbed green by default, so
+    a test flips exactly the one fact it seeds."""
+    kw = dict(
+        validated=frozenset(m.name for m in manifest),
+        tests=S.TestScan(
+            calls={m.name: frozenset(("tests/test_stub.py",))
+                   for m in manifest},
+            sharded_files=frozenset(), df_files=frozenset()),
+        documented=frozenset(m.name for m in manifest),
+        grad_names=frozenset(), tape_names=frozenset(),
+        oracle_names=frozenset(),
+    )
+    kw.update(overrides)
+    return S.audit_surface(tuple(manifest), namespace=namespace, **kw)
+
+
+def _stub(doc="stub."):
+    def hadamard(qureg, target):
+        pass
+    hadamard.__doc__ = doc
+    return hadamard
+
+
+def _codes(a):
+    return sorted(f.code for f in a.findings)
+
+
+def test_stub_surface_is_clean():
+    a = _run([_entry()], {"hadamard": _stub()})
+    assert _codes(a) == []
+    row = a.row("hadamard")
+    for col in ("exists", "signature", "validates", "documented", "tested"):
+        assert row.fact(col), col
+
+
+def test_dropped_function_is_qt901():
+    a = _run([_entry()], {})
+    assert _codes(a) == ["QT901"]
+    assert not a.row("hadamard").fact("exists")
+
+
+def test_signature_drift_is_qt902():
+    a = _run([_entry(params=("qureg", "qubit_index"))], {"hadamard": _stub()})
+    assert _codes(a) == ["QT902"]
+    assert not a.row("hadamard").fact("signature")
+    [f] = a.findings
+    assert "qubit_index" in f.message and "target" in f.message
+
+
+def test_stripped_validator_is_qt903():
+    a = _run([_entry()], {"hadamard": _stub()}, validated=frozenset())
+    assert _codes(a) == ["QT903"]
+    assert not a.row("hadamard").fact("validates")
+
+
+def test_validation_free_rows_are_exempt_from_qt903():
+    a = _run([_entry(needs_validation=False)], {"hadamard": _stub()},
+             validated=frozenset())
+    assert _codes(a) == []
+    assert a.row("hadamard").fact("validates")
+
+
+def test_untested_function_is_qt904():
+    empty = S.TestScan(calls={}, sharded_files=frozenset(),
+                       df_files=frozenset())
+    a = _run([_entry()], {"hadamard": _stub()}, tests=empty)
+    assert _codes(a) == ["QT904"]
+    assert not a.row("hadamard").fact("tested")
+
+
+def test_missing_docstring_is_qt906():
+    a = _run([_entry()], {"hadamard": _stub(doc=None)})
+    assert _codes(a) == ["QT906"]
+    assert not a.row("hadamard").fact("documented")
+
+
+def test_missing_docs_page_is_qt906():
+    a = _run([_entry()], {"hadamard": _stub()}, documented=frozenset())
+    assert _codes(a) == ["QT906"]
+
+
+def test_real_export_passes_stub_audit():
+    # the injectable namespace takes real callables too
+    a = _run([_entry()], {"hadamard": quest_tpu.hadamard})
+    assert _codes(a) == []
+
+
+# ---------------------------------------------------------------------------
+# QT905: the staleness gate over the committed files
+# ---------------------------------------------------------------------------
+
+def test_written_manifest_files_pass_gate(audit, tmp_path):
+    paths = S.write_manifest_files(audit, tmp_path)
+    assert sorted(p.name for p in paths) == [S.PARITY_MD, S.PARITY_JSON]
+    assert S.check_manifest_files(audit, tmp_path) == []
+
+
+def test_tampered_manifest_is_qt905(audit, tmp_path):
+    S.write_manifest_files(audit, tmp_path)
+    md = tmp_path / S.PARITY_MD
+    md.write_text(md.read_text().replace("| x |", "| . |", 1))
+    findings = S.check_manifest_files(audit, tmp_path)
+    assert [f.code for f in findings] == ["QT905"]
+    assert "stale" in findings[0].message
+
+
+def test_missing_manifest_is_qt905(audit, tmp_path):
+    S.write_manifest_files(audit, tmp_path)
+    (tmp_path / S.PARITY_JSON).unlink()
+    findings = S.check_manifest_files(audit, tmp_path)
+    assert [f.code for f in findings] == ["QT905"]
+    assert "missing" in findings[0].message

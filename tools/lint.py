@@ -2,7 +2,7 @@
 """Static-analysis CLI: run the plan verifier / ring checker / tape
 linter (quest_tpu.analysis, docs/analysis.md) from the command line.
 
-Five targets, one finding stream:
+Six targets, one finding stream:
 
   python tools/lint.py --bench-plans [--format json]
       Verify every bench.py --smoke plan config (plan_20q_relocation,
@@ -33,6 +33,14 @@ Five targets, one finding stream:
       for QT702 span-integrity findings: a finished trace that still
       carries an open span leaked an instrumentation handle. This is
       what the CI trace-smoke gate runs over the dryrun's export.
+
+  python tools/lint.py --surface [--write]
+      Run the QT9xx API-surface parity audit (quest_tpu.analysis.
+      surface, docs/parity.md): every reference L5 function classified
+      into the per-fact manifest columns, QT901/QT902/QT903 parity
+      errors, and the QT905 staleness gate over the committed PARITY.md
+      / parity.json (--write regenerates them first). This is what the
+      CI surface-audit gate runs.
 
 ``--differentiate`` layers the QT006 gradient lint onto the --qasm and
 --module targets: measurement/trajectory sites the adjoint engine
@@ -197,6 +205,14 @@ def main(argv=None) -> int:
     tgt.add_argument("--trace", metavar="FILE",
                      help="check an export_traces JSON file for QT702 "
                           "open-span findings")
+    tgt.add_argument("--surface", action="store_true",
+                     help="run the QT9xx API-surface parity audit "
+                          "(quest_tpu.analysis.surface, docs/parity.md): "
+                          "classify every reference L5 function and gate "
+                          "the committed PARITY.md / parity.json")
+    ap.add_argument("--write", action="store_true",
+                    help="with --surface: regenerate PARITY.md / "
+                         "parity.json before the staleness gate")
     ap.add_argument("--differentiate", action="store_true",
                     help="lint --qasm/--module circuits as tapes headed "
                          "for Circuit.gradient: QT006 flags measurement/"
@@ -214,6 +230,19 @@ def main(argv=None) -> int:
         import bench
         for spec in bench.smoke_plan_specs():
             findings += A.check_smoke_spec(spec)
+    elif args.surface:
+        from quest_tpu.analysis import surface as S
+        audit, findings = S.check_surface(write=args.write)
+        if args.format == "json":
+            import json as _json
+            print(_json.dumps(
+                {"manifest": _json.loads(S.parity_json(audit)),
+                 "findings": _json.loads(A.render_json(findings))},
+                sort_keys=True))
+        else:
+            print(S.render_parity_md(audit))
+            print(A.render_text(findings))
+        return 1 if A.error_findings(findings) else 0
     elif args.concurrency is not None:
         findings = A.lint_concurrency(args.concurrency or None)
     elif args.trace:
